@@ -1,0 +1,33 @@
+"""omldm_tpu — a TPU-native streaming online-machine-learning framework.
+
+A from-scratch JAX/XLA/pjit/pallas re-design of the capabilities of
+ArisKonidaris/OMLDM (reference mounted at /root/reference): a streaming,
+distributed, online ML serving-and-training system hosting many concurrent ML
+pipelines, training them with pluggable distributed-learning protocols over a
+worker <-> parameter-server topology, and emitting predictions, query
+responses, and training statistics back to the stream.
+
+Where the reference runs per-record JVM learners inside Flink operators and
+routes the parameter-server feedback edge through a Kafka topic
+(reference: src/main/scala/omldm/Job.scala:76-87), this framework runs
+``jax.jit``-compiled micro-batch learner updates on TPU HBM and performs
+protocol synchronization as XLA collectives (psum / pmean / reduce_scatter /
+all_gather) over the ICI mesh, with a host-side async stream runtime handling
+ingest, control requests, checkpointing, and the statistics/termination
+harness.
+
+Layer map (mirrors SURVEY.md section 1):
+    - ``omldm_tpu.api``           external JSON contract (ControlAPI POJOs)
+    - ``omldm_tpu.learners``      online learner kernels (mlAPI learners)
+    - ``omldm_tpu.preprocessors`` streaming feature transforms
+    - ``omldm_tpu.pipelines``     preprocessors + learner composition
+    - ``omldm_tpu.protocols``     the 8 distributed-learning protocols
+    - ``omldm_tpu.parallel``      mesh / sharding / collective utilities
+    - ``omldm_tpu.runtime``       host-side stream runtime (spoke/hub/job)
+    - ``omldm_tpu.checkpoint``    snapshot / restore / rescale-merge
+    - ``omldm_tpu.ops``           pallas kernels for hot ops
+"""
+
+__version__ = "0.1.0"
+
+from omldm_tpu.config import JobConfig  # noqa: F401

@@ -1,0 +1,30 @@
+"""External JSON contract of the framework (the reference's ControlAPI POJOs).
+
+The framework keeps the reference's external contract: JSON ``DataInstance`` /
+``Request`` records in; ``Prediction`` / ``QueryResponse`` / ``JobStatistics``
+out (SURVEY.md section 2.2, reference usage sites cited per class).
+"""
+
+from omldm_tpu.api.data import DataInstance, Prediction
+from omldm_tpu.api.requests import (
+    LearnerSpec,
+    PreprocessorSpec,
+    Request,
+    RequestType,
+    TrainingConfiguration,
+)
+from omldm_tpu.api.responses import QueryResponse
+from omldm_tpu.api.stats import JobStatistics, Statistics
+
+__all__ = [
+    "DataInstance",
+    "Prediction",
+    "LearnerSpec",
+    "PreprocessorSpec",
+    "Request",
+    "RequestType",
+    "TrainingConfiguration",
+    "QueryResponse",
+    "Statistics",
+    "JobStatistics",
+]

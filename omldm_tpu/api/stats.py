@@ -1,0 +1,143 @@
+"""Training statistics and the final job report.
+
+Reference counterpart: ControlAPI's ``Statistics`` ``{pipeline, protocol,
+modelsShipped, bytesShipped, numOfBlocks, fitted, learningCurve, LCX,
+meanBufferSize, score}`` with ``updateStats/updateFitted/updateScore/
+updateMeanBufferSize`` (reference:
+src/main/scala/omldm/operators/hub/FlinkHub.scala:118-153,
+src/main/scala/omldm/utils/statistics/StatisticsOperator.scala:96-125,
+src/main/scala/omldm/state/StateAccumulators.scala:62-124) and
+``JobStatistics(jobName, parallelism, durationMs, Statistics[])``
+(StatisticsOperator.scala:110-127).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Statistics:
+    """Per-pipeline protocol + accuracy statistics.
+
+    ``learning_curve`` is a list of (loss, #fitted) points — the reference
+    slices it incrementally out of the PS on each stats poll
+    (FlinkHub.scala:101-116,131-142); ``lcx`` is the matching x-axis
+    (#records-fitted checkpoints)."""
+
+    pipeline: int
+    protocol: str = ""
+    models_shipped: int = 0
+    bytes_shipped: int = 0
+    num_of_blocks: int = 0
+    fitted: int = 0
+    learning_curve: List[float] = dataclasses.field(default_factory=list)
+    lcx: List[int] = dataclasses.field(default_factory=list)
+    mean_buffer_size: float = 0.0
+    score: float = 0.0
+
+    def update_stats(
+        self,
+        models_shipped: int = 0,
+        bytes_shipped: int = 0,
+        num_of_blocks: int = 0,
+    ) -> None:
+        """Accumulate communication counters (FlinkHub.scala:118-127)."""
+        self.models_shipped += models_shipped
+        self.bytes_shipped += bytes_shipped
+        self.num_of_blocks += num_of_blocks
+
+    def update_fitted(self, fitted: int) -> None:
+        self.fitted += fitted
+
+    def update_score(self, score: float) -> None:
+        self.score = score
+
+    def update_mean_buffer_size(self, mbs: float) -> None:
+        self.mean_buffer_size = mbs
+
+    def extend_curve(self, points: List[Tuple[float, int]]) -> None:
+        """Append incremental learning-curve slices (FlinkHub.scala:101-116)."""
+        for loss, fitted in points:
+            self.learning_curve.append(float(loss))
+            self.lcx.append(int(fitted))
+
+    def normalize(self, count: int) -> None:
+        """Divide accumulated score / mean-buffer-size by the number of
+        contributors, mirroring the statistics operator's end-of-job
+        normalization over parallelism (StatisticsOperator.scala:100-125)."""
+        if count > 0:
+            self.score /= count
+            self.mean_buffer_size /= count
+
+    def merge(self, other: "Statistics") -> "Statistics":
+        """Cross-hub merge: sums counters, concatenates learning curves in
+        x order (StateAccumulators.scala:54-126).
+
+        ``score`` and ``mean_buffer_size`` are *accumulated* here and must be
+        normalized by the contributor count before reporting — the reference
+        does the same accumulate-then-normalize over parallelism
+        (StatisticsOperator.scala:109-125); call :meth:`normalize`."""
+        assert self.pipeline == other.pipeline
+        merged = Statistics(
+            pipeline=self.pipeline,
+            protocol=self.protocol or other.protocol,
+            models_shipped=self.models_shipped + other.models_shipped,
+            bytes_shipped=self.bytes_shipped + other.bytes_shipped,
+            num_of_blocks=self.num_of_blocks + other.num_of_blocks,
+            fitted=self.fitted + other.fitted,
+            mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
+            score=self.score + other.score,
+        )
+        pairs = sorted(
+            list(zip(self.lcx, self.learning_curve))
+            + list(zip(other.lcx, other.learning_curve)),
+            key=lambda p: p[0],
+        )
+        merged.lcx = [x for x, _ in pairs]
+        merged.learning_curve = [y for _, y in pairs]
+        return merged
+
+    def to_dict(self) -> dict:
+        return {
+            "pipeline": self.pipeline,
+            "protocol": self.protocol,
+            "modelsShipped": self.models_shipped,
+            "bytesShipped": self.bytes_shipped,
+            "numOfBlocks": self.num_of_blocks,
+            "fitted": self.fitted,
+            "learningCurve": self.learning_curve,
+            "LCX": self.lcx,
+            "meanBufferSize": self.mean_buffer_size,
+            "score": self.score,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+@dataclasses.dataclass
+class JobStatistics:
+    """Final job report shipped to the performance stream
+    (StatisticsOperator.scala:110-127, PerformanceWriter.scala:6-8)."""
+
+    job_name: str
+    parallelism: int
+    duration_ms: float
+    statistics: List[Statistics] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "jobName": self.job_name,
+            "parallelism": self.parallelism,
+            "durationMs": self.duration_ms,
+            "statistics": [s.to_dict() for s in self.statistics],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def __str__(self) -> str:  # PerformanceWriter stringification
+        return self.to_json()

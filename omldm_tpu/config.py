@@ -1,0 +1,121 @@
+"""Job-level configuration.
+
+Mirrors the reference's flag system: Flink ``ParameterTool`` CLI flags with code
+defaults (reference: src/main/scala/omldm/utils/DefaultJobParameters.scala:3-12,
+src/main/scala/omldm/Job.scala:113-120, README.md:28-41). Per-pipeline
+configuration arrives at runtime inside ``Request.training_configuration``
+(see omldm_tpu.api.requests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+
+@dataclasses.dataclass
+class JobConfig:
+    """Global job configuration.
+
+    Defaults replicate the reference's ``DefaultJobParameters``
+    (DefaultJobParameters.scala:4-11): parallelism 16, maxMsgParams 2000,
+    timeout 30_000 ms, testSetSize 256, test mode on.
+
+    TPU-specific knobs (micro-batching, dtype, mesh shape) have no reference
+    counterpart: the reference fits one record at a time on the JVM
+    (hs_err_pid77107.log:110-111); on TPU the unit of work is a fixed-shape
+    micro-batch so XLA compiles the training step once.
+    """
+
+    job_name: str = "OMLDM"
+    # Number of logical workers (spokes). Reference default 16
+    # (DefaultJobParameters.scala:5).
+    parallelism: int = 16
+    # Message-size cap in #parameters for protocol messages
+    # (DefaultJobParameters.scala:6, FlinkSpoke.scala:30).
+    max_msg_params: int = 2_000
+    # Silence timeout (ms) after which the statistics operator fires the
+    # termination probe (DefaultJobParameters.scala:10,
+    # StatisticsOperator.scala:91).
+    timeout_ms: int = 30_000
+    # Per-worker holdout test-set size (DefaultJobParameters.scala:11).
+    test_set_size: int = 256
+    # Test mode: holdout sampling, poll markers, stats harness, timer-driven
+    # self-termination (DefaultJobParameters.scala:9, FlinkLearning.scala:43).
+    test: bool = True
+    # Checkpointing (opt-in in the reference: Job.scala:120,
+    # Checkpointing.scala:9-25; 5000 ms default interval).
+    checkpointing: bool = False
+    check_interval_ms: int = 5_000
+    checkpoint_dir: str = "/tmp/omldm_tpu_checkpoints"
+
+    # --- capacity limits (host-side buffering) ---
+    # Spoke training-record buffer cap (SpokeLogic.scala:32).
+    record_buffer_cap: int = 100_000
+    # Spoke request buffer cap (SpokeLogic.scala:34).
+    request_buffer_cap: int = 10_000
+    # Hub pre-creation message cache cap (StateAccumulators.scala:128-146).
+    hub_cache_cap: int = 20_000
+    # PS model-state bucket size in #parameters (FlinkNetwork.scala:50).
+    max_param_bucket_size: int = 10_000
+    # Poll/progress marker cadence in #training records (FlinkSpoke.scala:83-89).
+    poll_every: int = 100
+
+    # --- TPU-native knobs (no reference counterpart) ---
+    # Micro-batch size per training step; records are padded + masked to this
+    # fixed shape so the jitted step never recompiles.
+    batch_size: int = 256
+    # Compute dtype for learner math. bfloat16 keeps matmuls on the MXU at
+    # full rate; params are kept in float32.
+    compute_dtype: str = "float32"
+    # Mesh axis sizes: data-parallel spokes ("dp") and sharded parameter
+    # server ("hub", the reference's HubParallelism).
+    mesh_shape: Mapping[str, int] = dataclasses.field(
+        default_factory=lambda: {"dp": 1, "hub": 1}
+    )
+
+    # Aliases mapping the reference's exact CLI flag names to our fields
+    # (FlinkLearning.scala:43-48, Job.scala:120, Checkpointing.scala:15-22).
+    _FLAG_ALIASES = {
+        "timeout": "timeout_ms",
+        "checkInterval": "check_interval_ms",
+        "stateBackend": "checkpoint_dir",
+        "jobName": "job_name",
+    }
+
+    @classmethod
+    def from_args(cls, args: Mapping[str, Any]) -> "JobConfig":
+        """Build a config from a flat string map (CLI-style), mirroring
+        ``ParameterTool.fromArgs`` (Job.scala:114). Accepts snake_case,
+        camelCase, and the reference's own flag names (e.g. ``timeout``)."""
+        cfg = cls()
+        args = dict(args)
+        for alias, field_name in cls._FLAG_ALIASES.items():
+            if alias in args and field_name not in args:
+                args[field_name] = args.pop(alias)
+        for field in dataclasses.fields(cls):
+            for key in (field.name, _camel(field.name)):
+                if key in args:
+                    raw = args[key]
+                    current = getattr(cfg, field.name)
+                    if isinstance(current, bool):
+                        value = str(raw).lower() in ("1", "true", "yes", "on")
+                    elif isinstance(current, int):
+                        value = int(raw)
+                    elif isinstance(current, str):
+                        value = str(raw)
+                    elif field.name == "mesh_shape" and isinstance(raw, str):
+                        # "dp=8,hub=2" -> {"dp": 8, "hub": 2}
+                        value = {
+                            k.strip(): int(v)
+                            for k, v in (p.split("=") for p in raw.split(",") if p)
+                        }
+                    else:
+                        value = raw
+                    setattr(cfg, field.name, value)
+        return cfg
+
+
+def _camel(snake: str) -> str:
+    head, *tail = snake.split("_")
+    return head + "".join(t.capitalize() for t in tail)

@@ -1,0 +1,145 @@
+"""Tests for the external JSON contract (omldm_tpu.api)."""
+
+import json
+
+from omldm_tpu.api import (
+    DataInstance,
+    JobStatistics,
+    QueryResponse,
+    Request,
+    RequestType,
+    Statistics,
+)
+from omldm_tpu.config import JobConfig
+
+
+class TestDataInstance:
+    def test_parse_training_record(self):
+        rec = DataInstance.from_json(
+            '{"numericalFeatures": [1.0, 2.0, 3.0], "target": 1.0,'
+            ' "operation": "training"}'
+        )
+        assert rec is not None
+        assert rec.numerical_features == [1.0, 2.0, 3.0]
+        assert rec.target == 1.0
+        assert rec.operation == "training"
+
+    def test_parse_forecasting_record(self):
+        rec = DataInstance.from_json(
+            '{"id": 7, "numericalFeatures": [0.5], "operation": "forecasting"}'
+        )
+        assert rec is not None
+        assert rec.operation == "forecasting"
+        assert rec.id == 7
+
+    def test_drops_eos_marker(self):
+        # DataInstanceParser.scala:14 drops the "EOS" marker
+        assert DataInstance.from_json("EOS") is None
+        assert DataInstance.from_json('"EOS"') is None
+
+    def test_drops_invalid(self):
+        assert DataInstance.from_json("not json at all {") is None
+        assert DataInstance.from_json('{"operation": "training"}') is None  # no features
+        assert (
+            DataInstance.from_json('{"numericalFeatures": [1], "operation": "bogus"}')
+            is None
+        )
+
+    def test_roundtrip(self):
+        rec = DataInstance(
+            numerical_features=[1.0], discrete_features=[2], target=0.0
+        )
+        back = DataInstance.from_json(rec.to_json())
+        assert back is not None
+        assert back.numerical_features == [1.0]
+        assert back.discrete_features == [2]
+        assert back.target == 0.0
+
+
+class TestRequest:
+    CREATE = {
+        "id": 0,
+        "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 0.01}},
+        "preProcessors": [{"name": "StandardScaler"}],
+        "trainingConfiguration": {"protocol": "Synchronous", "HubParallelism": 2},
+    }
+
+    def test_parse_create(self):
+        req = Request.from_json(json.dumps(self.CREATE))
+        assert req is not None
+        assert req.id == 0
+        assert req.request == RequestType.CREATE
+        assert req.learner.name == "PA"
+        assert req.learner.hyper_parameters["C"] == 0.01
+        assert req.preprocessors[0].name == "StandardScaler"
+        assert req.training_configuration.protocol == "Synchronous"
+        assert req.training_configuration.hub_parallelism == 2
+
+    def test_parse_malformed_returns_none(self):
+        assert Request.from_json("{") is None
+        assert Request.from_json('{"request": "Create"}') is None  # no id
+
+    def test_roundtrip(self):
+        req = Request.from_json(json.dumps(self.CREATE))
+        back = Request.from_json(req.to_json())
+        assert back.to_dict() == req.to_dict()
+
+    def test_default_protocol_is_asynchronous(self):
+        # MLNodeGenerator.scala:28 falls back to the async protocol
+        req = Request.from_json('{"id": 1, "request": "Create", "learner": {"name": "PA"}}')
+        assert req.training_configuration.protocol == "Asynchronous"
+        assert req.training_configuration.hub_parallelism == 1
+
+
+class TestStatistics:
+    def test_merge_sums_and_concatenates(self):
+        a = Statistics(pipeline=0, protocol="FGM", models_shipped=3, bytes_shipped=100)
+        a.extend_curve([(0.5, 100), (0.4, 300)])
+        b = Statistics(pipeline=0, protocol="FGM", models_shipped=2, bytes_shipped=50)
+        b.extend_curve([(0.45, 200)])
+        m = a.merge(b)
+        assert m.models_shipped == 5
+        assert m.bytes_shipped == 150
+        assert m.lcx == [100, 200, 300]  # x-sorted concatenation
+        assert m.learning_curve == [0.5, 0.45, 0.4]
+
+    def test_job_statistics_json(self):
+        s = Statistics(pipeline=0, protocol="Synchronous", fitted=1000, score=0.8)
+        js = JobStatistics("job", 8, 1234.5, [s])
+        obj = json.loads(js.to_json())
+        assert obj["jobName"] == "job"
+        assert obj["parallelism"] == 8
+        assert obj["statistics"][0]["fitted"] == 1000
+
+
+class TestQueryResponse:
+    def test_roundtrip(self):
+        qr = QueryResponse(
+            response_id=5, mlp_id=0, bucket=1, num_buckets=3,
+            protocol="EASGD", data_fitted=10, loss=0.3, score=0.9,
+        )
+        back = QueryResponse.from_dict(json.loads(qr.to_json()))
+        assert back.response_id == 5
+        assert back.bucket == 1
+        assert back.num_buckets == 3
+        assert back.score == 0.9
+
+
+class TestJobConfig:
+    def test_reference_defaults(self):
+        # DefaultJobParameters.scala:4-11
+        cfg = JobConfig()
+        assert cfg.parallelism == 16
+        assert cfg.max_msg_params == 2000
+        assert cfg.timeout_ms == 30000
+        assert cfg.test_set_size == 256
+        assert cfg.test is True
+
+    def test_from_args_camel_and_snake(self):
+        cfg = JobConfig.from_args(
+            {"parallelism": "8", "testSetSize": "64", "test": "false"}
+        )
+        assert cfg.parallelism == 8
+        assert cfg.test_set_size == 64
+        assert cfg.test is False
