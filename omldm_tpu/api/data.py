@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 from typing import Any, Mapping, Optional, Sequence
 
 TRAINING = "training"
@@ -59,7 +60,23 @@ class DataInstance:
                 self.categorical_features,
             )
         )
-        return has_features
+        if not has_features:
+            return False
+        # Python's json.loads accepts bare NaN/Infinity literals that the
+        # reference's Jackson parser rejects; a single non-finite value would
+        # poison model parameters, so reject them here.
+        try:
+            for f in (self.numerical_features, self.discrete_features):
+                if f is not None and any(
+                    v is None or not math.isfinite(v) for v in f
+                ):
+                    return False
+            if self.target is not None and not math.isfinite(self.target):
+                return False
+        except TypeError:
+            # non-numeric feature elements (e.g. strings in numericalFeatures)
+            return False
+        return True
 
     # --- JSON codec (Jackson-compatible camelCase field names) ---
 

@@ -1,0 +1,40 @@
+"""Online learner kernels (the reference's mlAPI learner library)."""
+
+from omldm_tpu.learners.base import Learner, append_bias, masked_mean, sign_labels
+from omldm_tpu.learners.hoeffding_tree import HoeffdingTree
+from omldm_tpu.learners.kmeans import KMeans
+from omldm_tpu.learners.linear import (
+    ORR,
+    PAClassifier,
+    PARegressor,
+    RFFSVM,
+    SoftmaxClassifier,
+)
+from omldm_tpu.learners.multiclass_pa import MultiClassPA
+from omldm_tpu.learners.nn import NeuralNetwork
+from omldm_tpu.learners.registry import (
+    LEARNERS,
+    SINGLE_LEARNER_ONLY,
+    is_valid_learner,
+    make_learner,
+)
+
+__all__ = [
+    "Learner",
+    "append_bias",
+    "masked_mean",
+    "sign_labels",
+    "PAClassifier",
+    "PARegressor",
+    "ORR",
+    "RFFSVM",
+    "SoftmaxClassifier",
+    "MultiClassPA",
+    "KMeans",
+    "NeuralNetwork",
+    "HoeffdingTree",
+    "LEARNERS",
+    "SINGLE_LEARNER_ONLY",
+    "is_valid_learner",
+    "make_learner",
+]

@@ -1,0 +1,128 @@
+"""Learner kernel interface: pure, jit-able online learners.
+
+Reference counterpart: the mlAPI learner hierarchy (PA, RegressorPA, ORR, SVM,
+MultiClassPA, K-means, NN, HT — allowlist at
+reference: src/main/scala/omldm/utils/parsers/requestStream/PipelineMap.scala:68)
+whose hot path is a per-record ``MLPipeline.pipePoint -> learner.fit``
+(hs_err_pid77107.log:109-113).
+
+TPU-first redesign: a learner is a *stateless module* operating on an explicit
+parameter pytree. The unit of work is a fixed-shape micro-batch ``(x[B, D],
+y[B], mask[B])`` so the jitted update compiles once and never recompiles.
+Two update semantics are supported:
+
+- ``update(params, x, y, mask)`` — high-throughput mini-batch semantics
+  (vectorized gradient / closed-form sufficient statistics on the MXU);
+- ``update_per_record(params, x, y, mask)`` — exact per-record online
+  semantics via ``lax.scan`` over the batch, matching the reference's
+  one-record-at-a-time fits for order-dependent rules (PA projections).
+
+Both return ``(new_params, mean_loss)``. Masked-out rows (padding of ragged
+micro-batches) contribute nothing to either the update or the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# A learner's parameters are an arbitrary pytree of jnp arrays.
+Params = Any
+Batch = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # x[B,D], y[B], mask[B]
+
+
+class Learner:
+    """Base class for online learner kernels.
+
+    Subclasses define pure static update rules; instances only hold
+    hyperparameters (plain Python scalars — safe to close over in jit).
+    """
+
+    #: registry name, matching the reference allowlist where applicable
+    name: str = ""
+    #: "classification" | "regression" | "clustering"
+    task: str = "classification"
+    #: True for learners whose model is a mutable host structure (HT): the
+    #: pipeline skips jit and keeps their updates on host, mirroring the
+    #: reference's SingleLearner carve-out (FlinkSpoke.scala:203-210)
+    host_side: bool = False
+
+    def __init__(self, hyper_parameters: Optional[Mapping[str, Any]] = None,
+                 data_structure: Optional[Mapping[str, Any]] = None):
+        self.hp = dict(hyper_parameters or {})
+        self.ds = dict(data_structure or {})
+
+    # --- required interface ---
+
+    def init(self, dim: int, rng: Optional[jax.Array] = None) -> Params:
+        raise NotImplementedError
+
+    def predict(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        """Batched prediction: x[B, D] -> y_hat[B]."""
+        raise NotImplementedError
+
+    def update(self, params: Params, x, y, mask) -> Tuple[Params, jnp.ndarray]:
+        """Mini-batch update; returns (new_params, mean_loss over valid rows)."""
+        raise NotImplementedError
+
+    def loss(self, params: Params, x, y, mask) -> jnp.ndarray:
+        """Mean loss over valid rows without updating."""
+        raise NotImplementedError
+
+    # --- optional interface with defaults ---
+
+    def update_per_record(self, params: Params, x, y, mask) -> Tuple[Params, jnp.ndarray]:
+        """Exact per-record online pass (lax.scan over the batch). Default:
+        scan the mini-batch rule with B=1 slices — subclasses with
+        order-dependent rules rely on this for reference parity."""
+
+        def step(p, row):
+            xi, yi, mi = row
+            new_p, l = self.update(p, xi[None, :], yi[None], mi[None])
+            return new_p, l
+
+        params, losses = jax.lax.scan(step, params, (x, y, mask))
+        total = jnp.maximum(jnp.sum(mask), 1.0)
+        return params, jnp.sum(losses * mask) / total
+
+    def score(self, params: Params, x, y, mask) -> jnp.ndarray:
+        """Quality metric over valid rows: accuracy for classification,
+        negative RMSE for regression (higher is better for both, so the
+        statistics-normalization path can average scores uniformly,
+        StatisticsOperator.scala:100-125)."""
+        if self.task == "classification":
+            preds = self.predict(params, x)
+            correct = (preds == sign_labels(y)).astype(jnp.float32)
+            return masked_mean(correct, mask)
+        preds = self.predict(params, x)
+        mse = masked_mean((preds - y) ** 2, mask)
+        return -jnp.sqrt(mse)
+
+    def merge(self, params_list):
+        """Average parameter pytrees — used on rescale/restore, mirroring the
+        reference's wrapper merge hooks (FlinkSpoke.scala:289-330,
+        StateAccumulators.scala:177-180)."""
+        return jax.tree_util.tree_map(
+            lambda *ps: sum(ps) / float(len(ps)), *params_list
+        )
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over rows where mask==1; 0 if no valid rows."""
+    total = jnp.sum(mask)
+    return jnp.where(total > 0, jnp.sum(values * mask) / jnp.maximum(total, 1.0), 0.0)
+
+
+def sign_labels(y: jnp.ndarray) -> jnp.ndarray:
+    """Map {0,1} or {-1,+1} targets to signed labels in {-1,+1}."""
+    return jnp.where(y > 0, 1.0, -1.0)
+
+
+def append_bias(x: jnp.ndarray) -> jnp.ndarray:
+    """Append a constant-1 column: [B, D] -> [B, D+1] so linear learners keep
+    an intercept inside one fused matmul (the reference keeps a separate bias
+    in VectorBias, StateAccumulators.scala:25-27; folding it into the weight
+    vector keeps the op a single MXU dot)."""
+    return jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)

@@ -1,0 +1,90 @@
+"""Online K-means clustering (``K-means``).
+
+Reference counterpart: mlAPI's K-means online clusterer (allowlist,
+PipelineMap.scala:68); the reference forces the ``SingleLearner`` protocol
+for it (FlinkSpoke.scala:203-210) — one central model, workers forward raw
+tuples — and this framework honors the same carve-out at the protocol layer.
+
+TPU-first design: mini-batch k-means (Sculley 2010). One batched distance
+matrix ``[B, K]`` on the MXU, per-centroid masked means, per-centroid
+learning rate 1/count — which for per-record batches degenerates to the
+classic online k-means rule the reference uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from omldm_tpu.learners.base import Learner, Params, masked_mean
+
+
+class KMeans(Learner):
+    """Hyper-parameters: ``k`` (default 2), ``initScale`` (random init spread,
+    default 1.0)."""
+
+    name = "K-means"
+    task = "clustering"
+
+    def _k(self) -> int:
+        return int(self.hp.get("k", self.ds.get("k", 2)))
+
+    def init(self, dim: int, rng: Optional[jax.Array] = None) -> Params:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        scale = float(self.hp.get("initScale", 1.0))
+        return {
+            "centroids": scale * jax.random.normal(rng, (self._k(), dim), jnp.float32),
+            "counts": jnp.zeros((self._k(),), jnp.float32),
+        }
+
+    def _dists(self, params, x):
+        # [B, K] squared distances via one matmul: |x|^2 - 2 x.c + |c|^2
+        c = params["centroids"]
+        return (
+            jnp.sum(x * x, axis=1, keepdims=True)
+            - 2.0 * x @ c.T
+            + jnp.sum(c * c, axis=1)[None, :]
+        )
+
+    def predict(self, params, x):
+        return jnp.argmin(self._dists(params, x), axis=1).astype(jnp.float32)
+
+    def loss(self, params, x, y, mask):
+        """Mean squared distance to the assigned centroid (inertia)."""
+        d = jnp.min(self._dists(params, x), axis=1)
+        return masked_mean(d, mask)
+
+    def update(self, params, x, y, mask):
+        d = self._dists(params, x)
+        assign = jnp.argmin(d, axis=1)  # [B]
+        K = params["centroids"].shape[0]
+        onehot = jax.nn.one_hot(assign, K, dtype=jnp.float32) * mask[:, None]  # [B,K]
+        batch_counts = jnp.sum(onehot, axis=0)  # [K]
+        new_counts = params["counts"] + batch_counts
+        sums = onehot.T @ x  # [K, D]
+        # per-centroid step toward the batch mean with lr = batch_n / total_n
+        batch_mean = sums / jnp.maximum(batch_counts, 1.0)[:, None]
+        lr = (batch_counts / jnp.maximum(new_counts, 1.0))[:, None]
+        moved = params["centroids"] + lr * (batch_mean - params["centroids"])
+        new_centroids = jnp.where(batch_counts[:, None] > 0, moved, params["centroids"])
+        new_params = {"centroids": new_centroids, "counts": new_counts}
+        return new_params, self.loss(params, x, y, mask)
+
+    def score(self, params, x, y, mask):
+        """Negative RMS distance to assigned centroid (higher is better)."""
+        return -jnp.sqrt(jnp.maximum(self.loss(params, x, y, mask), 0.0))
+
+    def merge(self, params_list):
+        """Count-weighted centroid average."""
+        counts = [p["counts"] for p in params_list]
+        total = sum(counts)
+        weighted = sum(
+            p["centroids"] * jnp.maximum(c, 0.0)[:, None]
+            for p, c in zip(params_list, counts)
+        )
+        safe_total = jnp.maximum(total, 1.0)[:, None]
+        base = params_list[0]["centroids"]
+        merged = jnp.where(total[:, None] > 0, weighted / safe_total, base)
+        return {"centroids": merged, "counts": total}

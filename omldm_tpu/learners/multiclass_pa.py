@@ -1,0 +1,74 @@
+"""Multiclass Passive-Aggressive classifier (``MultiClassPA``).
+
+Reference counterpart: mlAPI's MultiClassPA learner (allowlist,
+PipelineMap.scala:68). Multi-prototype PA (Crammer et al. 2006 sec. 8):
+one weight vector per class; on error the true-class prototype moves toward
+x and the highest-scoring wrong prototype moves away, each by tau/2-weighted
+steps (here the full tau split across the two prototypes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from omldm_tpu.learners.base import Learner, Params, append_bias, masked_mean
+from omldm_tpu.learners.linear import _pa_tau
+
+
+class MultiClassPA(Learner):
+    """Hyper-parameters: ``C`` (default 0.01), ``variant`` in {PA, PA-I,
+    PA-II}, ``nClasses`` (default from data_structure, else 3)."""
+
+    name = "MultiClassPA"
+    task = "classification"
+
+    def _n_classes(self) -> int:
+        return int(self.hp.get("nClasses", self.ds.get("nClasses", 3)))
+
+    def init(self, dim: int, rng: Optional[jax.Array] = None) -> Params:
+        return {"W": jnp.zeros((self._n_classes(), dim + 1), jnp.float32)}
+
+    def _scores(self, params, xb):
+        return xb @ params["W"].T  # [B, K]
+
+    def predict(self, params, x):
+        return jnp.argmax(self._scores(params, append_bias(x)), axis=1).astype(
+            jnp.float32
+        )
+
+    def _hinge(self, params, xb, y):
+        scores = self._scores(params, xb)  # [B, K]
+        yi = y.astype(jnp.int32)
+        true_score = jnp.take_along_axis(scores, yi[:, None], axis=1)[:, 0]
+        masked_scores = scores.at[jnp.arange(scores.shape[0]), yi].set(-jnp.inf)
+        rival = jnp.argmax(masked_scores, axis=1)
+        rival_score = jnp.max(masked_scores, axis=1)
+        return jnp.maximum(0.0, 1.0 - (true_score - rival_score)), rival
+
+    def loss(self, params, x, y, mask):
+        hinge, _ = self._hinge(params, append_bias(x), y)
+        return masked_mean(hinge, mask)
+
+    def update(self, params, x, y, mask):
+        C = float(self.hp.get("C", 0.01))
+        variant = str(self.hp.get("variant", "PA-I"))
+        xb = append_bias(x)
+        hinge, rival = self._hinge(params, xb, y)
+        # squared norm of the effective update direction is 2*||x||^2
+        # (one prototype moves up, one down)
+        tau = _pa_tau(hinge, 2.0 * jnp.sum(xb * xb, axis=1), variant, C)
+        coef = tau * mask  # [B]
+        yi = y.astype(jnp.int32)
+        K = params["W"].shape[0]
+        up = jax.nn.one_hot(yi, K, dtype=jnp.float32)  # [B, K]
+        down = jax.nn.one_hot(rival, K, dtype=jnp.float32)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        delta = ((up - down) * coef[:, None]).T @ xb / denom  # [K, D+1]
+        return {"W": params["W"] + delta}, masked_mean(hinge, mask)
+
+    def score(self, params, x, y, mask):
+        correct = (self.predict(params, x) == y).astype(jnp.float32)
+        return masked_mean(correct, mask)

@@ -1,0 +1,114 @@
+"""Neural-network learner (``NN``): an MLP trained online with mini-batches.
+
+Reference counterpart: ``mlAPI.learners.classification.nn.NeuralNetwork`` with
+``fitLoss``/``fitMiniBatchLoss``, backed by Deeplearning4j ``MultiLayerNetwork``
++ ND4J native C++ kernels (hs_err_pid77107.log:104-110). Here the whole
+network is a pytree and the training step is one fused XLA program on the
+MXU — the TPU-native replacement for the DL4J/JNI/OpenBLAS stack
+(SURVEY.md section 2.3).
+
+Data-structure config: ``hiddenLayers`` (list of widths, default [64, 64]),
+``nClasses`` (default 2 => single-logit binary head), ``activation``
+("relu" | "tanh", default "relu"). Hyper-parameters: ``learningRate``
+(default 1e-2), ``optimizer`` ("sgd" | "adam", default "adam"),
+``momentum`` (sgd only, default 0.0).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from omldm_tpu.learners.base import Learner, Params, masked_mean
+
+
+class NeuralNetwork(Learner):
+    name = "NN"
+    task = "classification"
+
+    def __init__(self, hyper_parameters=None, data_structure=None):
+        super().__init__(hyper_parameters, data_structure)
+        self._tx = self._make_optimizer()
+
+    def _make_optimizer(self):
+        lr = float(self.hp.get("learningRate", 1e-2))
+        opt = str(self.hp.get("optimizer", "adam")).lower()
+        if opt == "sgd":
+            return optax.sgd(lr, momentum=float(self.hp.get("momentum", 0.0)))
+        return optax.adam(lr)
+
+    def _widths(self, dim: int) -> List[int]:
+        hidden = [int(h) for h in self.ds.get("hiddenLayers", [64, 64])]
+        n_out = int(self.ds.get("nClasses", 2))
+        out = 1 if n_out == 2 else n_out
+        return [dim] + hidden + [out]
+
+    def _act(self, h):
+        return jnp.tanh(h) if str(self.ds.get("activation", "relu")) == "tanh" else jax.nn.relu(h)
+
+    def init(self, dim: int, rng: Optional[jax.Array] = None) -> Params:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        widths = self._widths(dim)
+        layers = []
+        for i, (fan_in, fan_out) in enumerate(zip(widths[:-1], widths[1:])):
+            rng, k = jax.random.split(rng)
+            scale = jnp.sqrt(2.0 / fan_in)
+            layers.append(
+                {
+                    "W": scale * jax.random.normal(k, (fan_in, fan_out), jnp.float32),
+                    "b": jnp.zeros((fan_out,), jnp.float32),
+                }
+            )
+        return {"layers": layers, "opt": self._tx.init(layers)}
+
+    def _forward(self, layers, x):
+        h = x
+        for layer in layers[:-1]:
+            h = self._act(h @ layer["W"] + layer["b"])
+        return h @ layers[-1]["W"] + layers[-1]["b"]  # logits [B, out]
+
+    def predict(self, params, x):
+        logits = self._forward(params["layers"], x)
+        if logits.shape[1] == 1:
+            return (logits[:, 0] > 0).astype(jnp.float32)
+        return jnp.argmax(logits, axis=1).astype(jnp.float32)
+
+    def _nll(self, layers, x, y, mask):
+        logits = self._forward(layers, x)
+        if logits.shape[1] == 1:
+            # binary: logistic loss on the single logit
+            ys = jnp.where(y > 0, 1.0, 0.0)
+            nll = optax.sigmoid_binary_cross_entropy(logits[:, 0], ys)
+        else:
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y.astype(jnp.int32)
+            )
+        return masked_mean(nll, mask)
+
+    def loss(self, params, x, y, mask):
+        return self._nll(params["layers"], x, y, mask)
+
+    def update(self, params, x, y, mask):
+        loss_val, grads = jax.value_and_grad(self._nll)(params["layers"], x, y, mask)
+        updates, new_opt = self._tx.update(grads, params["opt"], params["layers"])
+        new_layers = optax.apply_updates(params["layers"], updates)
+        return {"layers": new_layers, "opt": new_opt}, loss_val
+
+    def score(self, params, x, y, mask):
+        if int(self.ds.get("nClasses", 2)) == 2:
+            ys = jnp.where(y > 0, 1.0, 0.0)
+            correct = (self.predict(params, x) == ys).astype(jnp.float32)
+        else:
+            correct = (self.predict(params, x) == y).astype(jnp.float32)
+        return masked_mean(correct, mask)
+
+    def merge(self, params_list):
+        """Average network weights; reset optimizer state (momentum buffers
+        from different replicas are not meaningfully averageable)."""
+        layers = jax.tree_util.tree_map(
+            lambda *ps: sum(ps) / float(len(ps)), *[p["layers"] for p in params_list]
+        )
+        return {"layers": layers, "opt": self._tx.init(layers)}

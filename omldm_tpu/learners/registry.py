@@ -1,0 +1,55 @@
+"""Learner registry: the reference's learner allowlist, plus extensions.
+
+Reference counterpart: ``ValidLists.learners = PA, RegressorPA, ORR, SVM,
+MultiClassPA, K-means, NN, HT``
+(reference: src/main/scala/omldm/utils/parsers/requestStream/PipelineMap.scala:66-69).
+``Softmax`` is an extension (BASELINE.md config 5: multiclass softmax +
+hashed features).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from omldm_tpu.api.requests import LearnerSpec
+from omldm_tpu.learners.base import Learner
+from omldm_tpu.learners.hoeffding_tree import HoeffdingTree
+from omldm_tpu.learners.kmeans import KMeans
+from omldm_tpu.learners.linear import (
+    ORR,
+    PAClassifier,
+    PARegressor,
+    RFFSVM,
+    SoftmaxClassifier,
+)
+from omldm_tpu.learners.multiclass_pa import MultiClassPA
+from omldm_tpu.learners.nn import NeuralNetwork
+
+LEARNERS: Dict[str, Type[Learner]] = {
+    "PA": PAClassifier,
+    "RegressorPA": PARegressor,
+    "ORR": ORR,
+    "SVM": RFFSVM,
+    "MultiClassPA": MultiClassPA,
+    "K-means": KMeans,
+    "NN": NeuralNetwork,
+    "HT": HoeffdingTree,
+    # extension beyond the reference allowlist
+    "Softmax": SoftmaxClassifier,
+}
+
+# Learners the reference forces onto the SingleLearner protocol (one central
+# model; workers forward raw tuples) — FlinkSpoke.scala:203-210.
+SINGLE_LEARNER_ONLY = frozenset({"HT", "K-means"})
+
+
+def is_valid_learner(name: str) -> bool:
+    return name in LEARNERS
+
+
+def make_learner(spec: LearnerSpec) -> Learner:
+    """Instantiate a learner from a request's LearnerSpec; raises KeyError on
+    unknown names (the control plane validates against the allowlist first,
+    PipelineMap.scala:22-47)."""
+    cls = LEARNERS[spec.name]
+    return cls(spec.hyper_parameters, spec.data_structure)

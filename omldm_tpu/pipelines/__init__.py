@@ -1,0 +1,5 @@
+"""ML pipeline composition (the reference's mlAPI.pipelines.MLPipeline)."""
+
+from omldm_tpu.pipelines.pipeline import MLPipeline
+
+__all__ = ["MLPipeline"]

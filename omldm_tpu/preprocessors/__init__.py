@@ -1,0 +1,23 @@
+"""Streaming feature preprocessors (the reference's mlAPI preprocessors)."""
+
+from omldm_tpu.preprocessors.base import Preprocessor
+from omldm_tpu.preprocessors.transforms import (
+    MinMaxScaler,
+    PolynomialFeatures,
+    StandardScaler,
+)
+from omldm_tpu.preprocessors.registry import (
+    PREPROCESSORS,
+    is_valid_preprocessor,
+    make_preprocessor,
+)
+
+__all__ = [
+    "Preprocessor",
+    "PolynomialFeatures",
+    "StandardScaler",
+    "MinMaxScaler",
+    "PREPROCESSORS",
+    "is_valid_preprocessor",
+    "make_preprocessor",
+]

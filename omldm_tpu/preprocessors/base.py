@@ -1,0 +1,47 @@
+"""Streaming preprocessor interface.
+
+Reference counterpart: the mlAPI preprocessor allowlist
+``PolynomialFeatures, StandardScaler, MinMaxScaler``
+(reference: src/main/scala/omldm/utils/parsers/requestStream/PipelineMap.scala:67)
+applied inside ``MLPipeline.pipePoint`` ahead of the learner
+(hs_err_pid77107.log:111).
+
+TPU-first design: a preprocessor is a stateless module over an explicit state
+pytree, so the whole pipeline (preps + learner update) fuses into one jitted
+XLA program. Statistics-learning preprocessors (scalers) update their running
+statistics from each micro-batch *before* transforming it — matching the
+online semantics of fitting one record at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+
+State = Any
+
+
+class Preprocessor:
+    name: str = ""
+
+    def __init__(self, hyper_parameters: Optional[Mapping[str, Any]] = None):
+        self.hp = dict(hyper_parameters or {})
+
+    def out_dim(self, dim: int) -> int:
+        """Output feature dimension for an input dimension ``dim``."""
+        return dim
+
+    def init(self, dim: int) -> State:
+        return ()
+
+    def update(self, state: State, x: jnp.ndarray, mask: jnp.ndarray) -> State:
+        """Learn running statistics from a masked micro-batch."""
+        return state
+
+    def transform(self, state: State, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def merge(self, states) -> State:
+        """Merge parallel states on rescale/restore."""
+        return states[0]

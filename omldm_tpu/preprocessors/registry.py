@@ -1,0 +1,30 @@
+"""Preprocessor registry mirroring the reference allowlist
+``PolynomialFeatures, StandardScaler, MinMaxScaler``
+(reference: src/main/scala/omldm/utils/parsers/requestStream/PipelineMap.scala:67).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from omldm_tpu.api.requests import PreprocessorSpec
+from omldm_tpu.preprocessors.base import Preprocessor
+from omldm_tpu.preprocessors.transforms import (
+    MinMaxScaler,
+    PolynomialFeatures,
+    StandardScaler,
+)
+
+PREPROCESSORS: Dict[str, Type[Preprocessor]] = {
+    "PolynomialFeatures": PolynomialFeatures,
+    "StandardScaler": StandardScaler,
+    "MinMaxScaler": MinMaxScaler,
+}
+
+
+def is_valid_preprocessor(name: str) -> bool:
+    return name in PREPROCESSORS
+
+
+def make_preprocessor(spec: PreprocessorSpec) -> Preprocessor:
+    return PREPROCESSORS[spec.name](spec.hyper_parameters)

@@ -1,0 +1,129 @@
+"""The three reference preprocessors, streaming-native.
+
+- ``StandardScaler`` — running mean/variance via batched Chan/Welford merge.
+- ``MinMaxScaler`` — running min/max.
+- ``PolynomialFeatures`` — degree-2/3 expansion, stateless; pairwise products
+  computed as one outer-product einsum (MXU-friendly, static shapes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from omldm_tpu.preprocessors.base import Preprocessor, State
+
+
+class StandardScaler(Preprocessor):
+    """z = (x - mean) / std with running statistics."""
+
+    name = "StandardScaler"
+
+    def init(self, dim: int) -> State:
+        return {
+            "count": jnp.zeros((), jnp.float32),
+            "mean": jnp.zeros((dim,), jnp.float32),
+            "m2": jnp.zeros((dim,), jnp.float32),
+        }
+
+    def update(self, state, x, mask):
+        """Chan et al. parallel update: merge the batch's masked moments into
+        the running moments in O(1) fused ops."""
+        n_b = jnp.sum(mask)
+        safe_n = jnp.maximum(n_b, 1.0)
+        mean_b = jnp.sum(x * mask[:, None], axis=0) / safe_n
+        delta_b = (x - mean_b) * mask[:, None]
+        m2_b = jnp.sum(delta_b * delta_b, axis=0)
+        n_a, mean_a, m2_a = state["count"], state["mean"], state["m2"]
+        n = n_a + n_b
+        safe_total = jnp.maximum(n, 1.0)
+        delta = mean_b - mean_a
+        new_mean = mean_a + delta * (n_b / safe_total)
+        new_m2 = m2_a + m2_b + delta * delta * (n_a * n_b / safe_total)
+        keep = n_b > 0
+        return {
+            "count": jnp.where(keep, n, n_a),
+            "mean": jnp.where(keep, new_mean, mean_a),
+            "m2": jnp.where(keep, new_m2, m2_a),
+        }
+
+    def transform(self, state, x):
+        var = jnp.where(
+            state["count"] > 1, state["m2"] / jnp.maximum(state["count"] - 1, 1.0), 1.0
+        )
+        std = jnp.sqrt(jnp.maximum(var, 1e-12))
+        return jnp.where(state["count"] > 0, (x - state["mean"]) / std, x)
+
+    def merge(self, states):
+        out = states[0]
+        for s in states[1:]:
+            n_a, n_b = out["count"], s["count"]
+            n = n_a + n_b
+            safe = jnp.maximum(n, 1.0)
+            delta = s["mean"] - out["mean"]
+            out = {
+                "count": n,
+                "mean": out["mean"] + delta * (n_b / safe),
+                "m2": out["m2"] + s["m2"] + delta * delta * (n_a * n_b / safe),
+            }
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """z = (x - min) / (max - min) with running extrema."""
+
+    name = "MinMaxScaler"
+
+    def init(self, dim: int) -> State:
+        return {
+            "min": jnp.full((dim,), jnp.inf, jnp.float32),
+            "max": jnp.full((dim,), -jnp.inf, jnp.float32),
+        }
+
+    def update(self, state, x, mask):
+        big = jnp.where(mask[:, None] > 0, x, jnp.inf)
+        small = jnp.where(mask[:, None] > 0, x, -jnp.inf)
+        return {
+            "min": jnp.minimum(state["min"], jnp.min(big, axis=0)),
+            "max": jnp.maximum(state["max"], jnp.max(small, axis=0)),
+        }
+
+    def transform(self, state, x):
+        seen = jnp.isfinite(state["min"]) & jnp.isfinite(state["max"])
+        span = jnp.maximum(state["max"] - state["min"], 1e-12)
+        scaled = (x - jnp.where(seen, state["min"], 0.0)) / jnp.where(seen, span, 1.0)
+        return jnp.where(seen, scaled, x)
+
+    def merge(self, states):
+        return {
+            "min": jnp.min(jnp.stack([s["min"] for s in states]), axis=0),
+            "max": jnp.max(jnp.stack([s["max"] for s in states]), axis=0),
+        }
+
+
+class PolynomialFeatures(Preprocessor):
+    """Degree-2 (default) polynomial expansion, stateless.
+
+    Output layout for degree 2: [x, upper-triangle of x⊗x (incl. squares)];
+    degree 3 additionally appends x_i^3 terms (full cubic cross-terms are
+    intentionally omitted to keep the feature count O(d^2)).
+    Hyper-parameter: ``degree`` (2 or 3, default 2)."""
+
+    name = "PolynomialFeatures"
+
+    def _degree(self) -> int:
+        return int(self.hp.get("degree", 2))
+
+    def out_dim(self, dim: int) -> int:
+        out = dim + dim * (dim + 1) // 2
+        if self._degree() >= 3:
+            out += dim
+        return out
+
+    def transform(self, state, x):
+        b, d = x.shape
+        outer = jnp.einsum("bi,bj->bij", x, x)
+        iu, ju = jnp.triu_indices(d)
+        feats = [x, outer[:, iu, ju]]
+        if self._degree() >= 3:
+            feats.append(x**3)
+        return jnp.concatenate(feats, axis=1)

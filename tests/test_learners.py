@@ -1,0 +1,269 @@
+"""Learner kernel tests: convergence on synthetic streams, jit-ability,
+masking, per-record vs mini-batch semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from omldm_tpu.api.requests import LearnerSpec
+from omldm_tpu.learners import (
+    LEARNERS,
+    HoeffdingTree,
+    KMeans,
+    make_learner,
+)
+
+
+def linear_binary_data(n, dim, seed=0, labels01=False):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    if not labels01:
+        y = 2 * y - 1
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def regression_data(n, dim, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = (x @ w + 0.5 + 0.01 * rng.randn(n)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def train_stream(learner, x, y, batch=64, per_record=False):
+    params = learner.init(x.shape[1], jax.random.PRNGKey(0))
+    fn = learner.update_per_record if per_record else learner.update
+    if not learner.host_side:
+        fn = jax.jit(fn)
+    for i in range(0, x.shape[0] - batch + 1, batch):
+        xb, yb = x[i : i + batch], y[i : i + batch]
+        mask = jnp.ones((xb.shape[0],), jnp.float32)
+        params, _ = fn(params, xb, yb, mask)
+    return params
+
+
+class TestPA:
+    def test_converges(self):
+        x, y = linear_binary_data(4096, 10)
+        learner = make_learner(LearnerSpec("PA", hyper_parameters={"C": 1.0}))
+        params = train_stream(learner, x, y)
+        acc = learner.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.9
+
+    def test_per_record_matches_reference_rule(self):
+        # single-record batch: mini-batch update must equal the textbook
+        # per-record PA-I projection
+        learner = make_learner(LearnerSpec("PA", hyper_parameters={"C": 10.0, "variant": "PA-I"}))
+        params = learner.init(3)
+        x = jnp.array([[1.0, 2.0, -1.0]])
+        y = jnp.array([1.0])
+        mask = jnp.ones((1,))
+        new_params, loss = learner.update(params, x, y, mask)
+        xb = np.array([1.0, 2.0, -1.0, 1.0])  # appended bias
+        l = max(0.0, 1.0 - 0.0)
+        tau = min(10.0, l / (xb @ xb))
+        np.testing.assert_allclose(new_params["w"], tau * xb, rtol=1e-5)
+        assert float(loss) == 1.0
+
+    def test_mask_excludes_rows(self):
+        learner = make_learner(LearnerSpec("PA"))
+        params = learner.init(3)
+        x = jnp.array([[1.0, 0.0, 0.0], [5.0, 5.0, 5.0]])
+        y = jnp.array([1.0, -1.0])
+        p_masked, _ = learner.update(params, x, y, jnp.array([1.0, 0.0]))
+        p_solo, _ = learner.update(params, x[:1], y[:1], jnp.array([1.0]))
+        np.testing.assert_allclose(p_masked["w"], p_solo["w"], rtol=1e-6)
+
+    def test_per_record_scan_runs(self):
+        x, y = linear_binary_data(512, 5)
+        learner = make_learner(LearnerSpec("PA", hyper_parameters={"C": 1.0}))
+        params = train_stream(learner, x, y, per_record=True)
+        acc = learner.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.9
+
+
+class TestRegressorPA:
+    def test_converges(self):
+        x, y = regression_data(4096, 8)
+        learner = make_learner(
+            LearnerSpec("RegressorPA", hyper_parameters={"C": 1.0, "epsilon": 0.01})
+        )
+        params = train_stream(learner, x, y, per_record=True)
+        rmse = -float(learner.score(params, x, y, jnp.ones(x.shape[0])))
+        assert rmse < 0.5
+
+
+class TestORR:
+    def test_matches_closed_form_ridge(self):
+        x, y = regression_data(1024, 6)
+        learner = make_learner(LearnerSpec("ORR", hyper_parameters={"lambda": 1.0}))
+        params = train_stream(learner, x, y, batch=128)
+        # closed form on the same 1024 rows (batches cover all rows)
+        xb = np.concatenate([np.asarray(x), np.ones((x.shape[0], 1))], axis=1)
+        w_ref = np.linalg.solve(xb.T @ xb + np.eye(7), xb.T @ np.asarray(y))
+        w_ours = np.asarray(jax.scipy.linalg.solve(params["A"], params["b"]))
+        np.testing.assert_allclose(w_ours, w_ref, rtol=1e-3, atol=1e-3)
+
+    def test_order_independent(self):
+        # sufficient statistics: batch split must not change the result
+        x, y = regression_data(256, 4)
+        learner = make_learner(LearnerSpec("ORR"))
+        p1 = train_stream(learner, x, y, batch=256)
+        p2 = train_stream(learner, x, y, batch=32)
+        np.testing.assert_allclose(np.asarray(p1["A"]), np.asarray(p2["A"]), rtol=1e-4)
+
+    def test_merge_sums_statistics(self):
+        x, y = regression_data(512, 4)
+        learner = make_learner(LearnerSpec("ORR"))
+        p_all = train_stream(learner, x, y, batch=256)
+        pa = train_stream(learner, x[:256], y[:256], batch=256)
+        pb = train_stream(learner, x[256:], y[256:], batch=256)
+        merged = learner.merge([pa, pb])
+        np.testing.assert_allclose(np.asarray(merged["A"]), np.asarray(p_all["A"]), rtol=1e-4)
+
+
+class TestSVM:
+    def test_linear_converges(self):
+        x, y = linear_binary_data(4096, 10)
+        learner = make_learner(LearnerSpec("SVM", hyper_parameters={"lambda": 1e-3}))
+        params = train_stream(learner, x, y)
+        acc = learner.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.9
+
+    def test_rff_learns_nonlinear(self):
+        # ring dataset: not linearly separable; RFF-SVM must beat linear SVM
+        rng = np.random.RandomState(1)
+        x = rng.randn(4096, 2).astype(np.float32)
+        r = np.linalg.norm(x, axis=1)
+        y = jnp.asarray((r < 1.1).astype(np.float32) * 2 - 1)
+        x = jnp.asarray(x)
+        rff = make_learner(
+            LearnerSpec(
+                "SVM",
+                hyper_parameters={"lambda": 1e-4},
+                data_structure={"rffDim": 256, "gamma": 1.0},
+            )
+        )
+        params = train_stream(rff, x, y, batch=128)
+        acc = rff.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.8
+
+
+class TestMultiClassPA:
+    def test_converges_3class(self):
+        rng = np.random.RandomState(0)
+        centers = np.array([[3, 0], [-3, 3], [-3, -3]], dtype=np.float32)
+        idx = rng.randint(0, 3, size=4096)
+        x = jnp.asarray(centers[idx] + 0.5 * rng.randn(4096, 2).astype(np.float32))
+        y = jnp.asarray(idx.astype(np.float32))
+        learner = make_learner(
+            LearnerSpec("MultiClassPA", hyper_parameters={"C": 1.0, "nClasses": 3})
+        )
+        params = train_stream(learner, x, y)
+        acc = learner.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.9
+
+
+class TestSoftmax:
+    def test_converges(self):
+        rng = np.random.RandomState(0)
+        centers = np.array([[2, 0], [-2, 2], [-2, -2]], dtype=np.float32)
+        idx = rng.randint(0, 3, size=4096)
+        x = jnp.asarray(centers[idx] + 0.5 * rng.randn(4096, 2).astype(np.float32))
+        y = jnp.asarray(idx.astype(np.float32))
+        learner = make_learner(
+            LearnerSpec("Softmax", hyper_parameters={"learningRate": 0.5, "nClasses": 3})
+        )
+        params = train_stream(learner, x, y)
+        acc = learner.score(params, x, y, jnp.ones(x.shape[0]))
+        assert acc > 0.9
+
+
+class TestKMeans:
+    def test_finds_clusters(self):
+        rng = np.random.RandomState(0)
+        centers = np.array([[4, 4], [-4, -4]], dtype=np.float32)
+        idx = rng.randint(0, 2, size=2048)
+        x = jnp.asarray(centers[idx] + 0.3 * rng.randn(2048, 2).astype(np.float32))
+        learner = make_learner(LearnerSpec("K-means", hyper_parameters={"k": 2}))
+        params = train_stream(learner, x, jnp.zeros(2048), batch=64)
+        c = np.sort(np.asarray(params["centroids"]), axis=0)
+        np.testing.assert_allclose(c, np.sort(centers, axis=0), atol=0.5)
+
+    def test_merge_weighted(self):
+        learner = KMeans({"k": 2})
+        pa = {"centroids": jnp.array([[1.0, 1.0], [0.0, 0.0]]), "counts": jnp.array([3.0, 0.0])}
+        pb = {"centroids": jnp.array([[3.0, 3.0], [9.0, 9.0]]), "counts": jnp.array([1.0, 0.0])}
+        merged = learner.merge([pa, pb])
+        np.testing.assert_allclose(np.asarray(merged["centroids"])[0], [1.5, 1.5])
+        np.testing.assert_allclose(np.asarray(merged["counts"]), [4.0, 0.0])
+
+
+class TestNN:
+    def test_learns_xor(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4096, 2).astype(np.float32)
+        y = jnp.asarray(((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.float32))
+        x = jnp.asarray(x)
+        learner = make_learner(
+            LearnerSpec(
+                "NN",
+                hyper_parameters={"learningRate": 1e-2},
+                data_structure={"hiddenLayers": [32, 32]},
+            )
+        )
+        params = learner.init(2, jax.random.PRNGKey(42))
+        step = jax.jit(learner.update)
+        mask = jnp.ones((128,))
+        for epoch in range(3):
+            for i in range(0, 4096, 128):
+                params, _ = step(params, x[i : i + 128], y[i : i + 128], mask)
+        acc = learner.score(params, x, y, jnp.ones(4096))
+        assert acc > 0.9
+
+    def test_multiclass_head(self):
+        learner = make_learner(
+            LearnerSpec("NN", data_structure={"nClasses": 4, "hiddenLayers": [8]})
+        )
+        params = learner.init(3, jax.random.PRNGKey(0))
+        preds = learner.predict(params, jnp.zeros((5, 3)))
+        assert preds.shape == (5,)
+
+
+class TestHoeffdingTree:
+    def test_learns_threshold_split(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(6000, 3).astype(np.float32)
+        y = (x[:, 1] > 0.3).astype(np.float32)
+        learner = HoeffdingTree({"gracePeriod": 100, "delta": 1e-3})
+        params = learner.init(3)
+        for i in range(0, 6000, 200):
+            mask = np.ones(200, dtype=np.float32)
+            params, _ = learner.update(params, x[i : i + 200], y[i : i + 200], mask)
+        assert params["n_nodes"] > 1  # it split
+        acc = float(learner.score(params, x, y, np.ones(6000)))
+        assert acc > 0.9
+
+
+class TestRegistry:
+    def test_allowlist_complete(self):
+        # PipelineMap.scala:68 allowlist
+        for name in ("PA", "RegressorPA", "ORR", "SVM", "MultiClassPA", "K-means", "NN", "HT"):
+            assert name in LEARNERS
+
+    @pytest.mark.parametrize("name", sorted(LEARNERS))
+    def test_init_update_predict_shapes(self, name):
+        learner = make_learner(LearnerSpec(name))
+        params = learner.init(4, jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+        y = jnp.zeros((8,))
+        mask = jnp.ones((8,))
+        params, loss = learner.update(params, x, y, mask)
+        preds = learner.predict(params, x)
+        assert preds.shape == (8,)
+        assert np.isfinite(float(loss))
+        assert np.isfinite(float(learner.loss(params, x, y, mask)))
+        assert np.isfinite(float(learner.score(params, x, y, mask)))

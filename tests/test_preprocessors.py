@@ -1,0 +1,168 @@
+"""Preprocessor + pipeline tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec
+from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.preprocessors import (
+    MinMaxScaler,
+    PolynomialFeatures,
+    StandardScaler,
+)
+
+
+class TestStandardScaler:
+    def test_running_stats_match_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1000, 5).astype(np.float32) * 3 + 2
+        sc = StandardScaler()
+        state = sc.init(5)
+        for i in range(0, 1000, 100):
+            xb = jnp.asarray(x[i : i + 100])
+            state = sc.update(state, xb, jnp.ones(100))
+        np.testing.assert_allclose(np.asarray(state["mean"]), x.mean(0), rtol=1e-4)
+        var = np.asarray(state["m2"]) / (np.asarray(state["count"]) - 1)
+        np.testing.assert_allclose(var, x.var(0, ddof=1), rtol=1e-3)
+        z = np.asarray(sc.transform(state, jnp.asarray(x)))
+        assert abs(z.mean()) < 0.01 and abs(z.std() - 1.0) < 0.01
+
+    def test_mask_excludes_padding(self):
+        sc = StandardScaler()
+        state = sc.init(2)
+        x = jnp.array([[1.0, 1.0], [999.0, 999.0]])
+        state = sc.update(state, x, jnp.array([1.0, 0.0]))
+        np.testing.assert_allclose(np.asarray(state["mean"]), [1.0, 1.0])
+        assert float(state["count"]) == 1.0
+
+    def test_merge(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(400, 3).astype(np.float32)
+        sc = StandardScaler()
+        s_all = sc.update(sc.init(3), jnp.asarray(x), jnp.ones(400))
+        sa = sc.update(sc.init(3), jnp.asarray(x[:150]), jnp.ones(150))
+        sb = sc.update(sc.init(3), jnp.asarray(x[150:]), jnp.ones(250))
+        merged = sc.merge([sa, sb])
+        np.testing.assert_allclose(
+            np.asarray(merged["mean"]), np.asarray(s_all["mean"]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(merged["m2"]), np.asarray(s_all["m2"]), rtol=1e-4
+        )
+
+
+class TestMinMaxScaler:
+    def test_scales_to_unit(self):
+        mm = MinMaxScaler()
+        state = mm.init(2)
+        x = jnp.array([[0.0, -10.0], [5.0, 10.0], [2.5, 0.0]])
+        state = mm.update(state, x, jnp.ones(3))
+        z = np.asarray(mm.transform(state, x))
+        np.testing.assert_allclose(z, [[0, 0], [1, 1], [0.5, 0.5]])
+
+    def test_identity_before_any_data(self):
+        mm = MinMaxScaler()
+        state = mm.init(2)
+        x = jnp.array([[3.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(mm.transform(state, x)), [[3.0, 4.0]])
+
+
+class TestPolynomialFeatures:
+    def test_degree2_layout(self):
+        pf = PolynomialFeatures()
+        assert pf.out_dim(3) == 3 + 6
+        x = jnp.array([[1.0, 2.0, 3.0]])
+        z = np.asarray(pf.transform((), x))[0]
+        # [x1,x2,x3, x1*x1, x1*x2, x1*x3, x2*x2, x2*x3, x3*x3]
+        np.testing.assert_allclose(z, [1, 2, 3, 1, 2, 3, 4, 6, 9])
+
+    def test_degree3_adds_cubes(self):
+        pf = PolynomialFeatures({"degree": 3})
+        assert pf.out_dim(2) == 2 + 3 + 2
+        z = np.asarray(pf.transform((), jnp.array([[2.0, 3.0]])))[0]
+        np.testing.assert_allclose(z, [2, 3, 4, 6, 9, 8, 27])
+
+
+class TestMLPipeline:
+    def test_scaler_plus_pa_learns_unnormalized_stream(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(4)
+        x = (rng.randn(4096, 4) * np.array([100.0, 0.01, 5.0, 1.0])).astype(np.float32)
+        y = ((x / np.array([100.0, 0.01, 5.0, 1.0])) @ w > 0).astype(np.float32) * 2 - 1
+        pipe = MLPipeline(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}),
+            [PreprocessorSpec("StandardScaler")],
+            dim=4,
+        )
+        for i in range(0, 4096, 128):
+            pipe.fit(
+                jnp.asarray(x[i : i + 128]),
+                jnp.asarray(y[i : i + 128]),
+                jnp.ones(128),
+            )
+        _, score = pipe.evaluate(jnp.asarray(x), jnp.asarray(y), jnp.ones(4096))
+        assert score > 0.9
+        assert pipe.fitted == 4096
+
+    def test_poly_pipeline_learns_quadratic(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4096, 2).astype(np.float32)
+        y = ((x[:, 0] * x[:, 1]) > 0).astype(np.float32) * 2 - 1  # XOR-ish, quadratic
+        pipe = MLPipeline(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}),
+            [PreprocessorSpec("PolynomialFeatures")],
+            dim=2,
+        )
+        for i in range(0, 4096, 128):
+            pipe.fit(jnp.asarray(x[i : i + 128]), jnp.asarray(y[i : i + 128]), jnp.ones(128))
+        _, score = pipe.evaluate(jnp.asarray(x), jnp.asarray(y), jnp.ones(4096))
+        assert score > 0.9
+
+    def test_curve_slices_are_incremental(self):
+        pipe = MLPipeline(LearnerSpec("PA"), dim=3)
+        x = jnp.ones((8, 3))
+        y = jnp.ones((8,))
+        pipe.fit(x, y, jnp.ones(8))
+        pipe.fit(x, y, jnp.ones(8))
+        s1 = pipe.curve_slice()
+        assert len(s1) == 2
+        assert s1[0][1] == 8 and s1[1][1] == 16
+        pipe.fit(x, y, jnp.ones(8))
+        s2 = pipe.curve_slice()
+        assert len(s2) == 1 and s2[1 - 1][1] == 24
+        assert pipe.curve_slice() == []
+
+    def test_flat_params_roundtrip(self):
+        pipe = MLPipeline(LearnerSpec("PA"), dim=3)
+        pipe.fit(jnp.ones((4, 3)), jnp.ones((4,)), jnp.ones(4))
+        flat, _ = pipe.get_flat_params()
+        assert flat.shape == (4,)  # w has dim+1
+        pipe.set_flat_params(np.zeros_like(flat))
+        flat2, _ = pipe.get_flat_params()
+        np.testing.assert_allclose(flat2, 0.0)
+
+    def test_merge_from(self):
+        a = MLPipeline(LearnerSpec("PA"), dim=2)
+        b = MLPipeline(LearnerSpec("PA"), dim=2)
+        a.fit(jnp.ones((4, 2)), jnp.ones(4), jnp.ones(4))
+        b.fit(-jnp.ones((4, 2)), jnp.ones(4), jnp.ones(4))
+        wa, _ = a.get_flat_params()
+        wb, _ = b.get_flat_params()
+        a.merge_from([b])
+        wm, _ = a.get_flat_params()
+        np.testing.assert_allclose(wm, (wa + wb) / 2, rtol=1e-6)
+        assert a.fitted == 8
+
+    def test_host_side_ht_pipeline(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3000, 3).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        pipe = MLPipeline(
+            LearnerSpec("HT", hyper_parameters={"gracePeriod": 100, "delta": 1e-3}),
+            dim=3,
+        )
+        for i in range(0, 3000, 200):
+            pipe.fit(x[i : i + 200], y[i : i + 200], np.ones(200, np.float32))
+        _, score = pipe.evaluate(x, y, np.ones(3000, np.float32))
+        assert score > 0.85
