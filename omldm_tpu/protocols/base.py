@@ -1,0 +1,134 @@
+"""Protocol node interfaces: worker (spoke-side) and hub (PS-side).
+
+Reference counterpart: the 8 protocol worker/PS pairs of mlAPI
+(``MLNodeGenerator.scala:20-76``) hosted inside ``BufferingWrapper`` /
+``GenericWrapper`` containers and talking through the
+``BipartiteTopologyAPI.interfaces.Network`` RPC plane
+(FlinkNetwork.scala:242-295).
+
+TPU redesign: nodes are plain Python objects exchanging in-process messages
+through a router (``send``/``broadcast`` callables) — the host-multiplexed
+mode. The SPMD mode (omldm_tpu.parallel) compiles the synchronous protocols
+into collectives instead; these host nodes remain the semantic reference and
+serve the asynchronous/stream-driven paths.
+
+A worker node wraps an ``MLPipeline`` replica. A hub node owns the protocol's
+global state (global params, staleness clocks, safe-zone state) and the
+per-pipeline ``Statistics``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.api.stats import Statistics
+from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.runtime.messages import payload_size
+
+# send(op: str, payload, hub_id: int) -> None           (worker -> hub)
+SendFn = Callable[[str, Any, int], None]
+# reply(worker_id: int, op: str, payload) -> None       (hub -> one worker)
+ReplyFn = Callable[[int, str, Any], None]
+# broadcast(op: str, payload) -> None                   (hub -> all workers)
+BroadcastFn = Callable[[str, Any], None]
+
+
+class WorkerNode:
+    """Spoke-side protocol node wrapping a local pipeline replica."""
+
+    def __init__(
+        self,
+        pipeline: MLPipeline,
+        worker_id: int,
+        n_workers: int,
+        config: TrainingConfiguration,
+        send: SendFn,
+    ):
+        self.pipeline = pipeline
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.config = config
+        self.send = send
+        self.paused = False  # toggle() support (FlinkSpoke.scala:130)
+
+    def on_start(self) -> None:
+        """Called once after creation (e.g. async workers pull the model)."""
+
+    def on_training_batch(self, x, y, mask) -> Optional[float]:
+        """Consume one micro-batch; returns the (lazy) loss or None if the
+        batch was forwarded elsewhere."""
+        raise NotImplementedError
+
+    def on_forecast_batch(self, x) -> np.ndarray:
+        """Serve predictions with the local (possibly stale) model."""
+        return np.asarray(self.pipeline.predict(x))
+
+    def receive(self, op: str, payload: Any) -> None:
+        """Handle a hub->worker message."""
+
+    def query_stats(self) -> dict:
+        """Fitted/loss numbers for query responses. Protocols whose model
+        lives on the hub (SingleLearner) override this with the hub-reported
+        values (FlinkHub.scala:128-153)."""
+        return {
+            "data_fitted": self.pipeline.fitted,
+            "cumulative_loss": self.pipeline.cumulative_loss,
+        }
+
+    def on_flush(self) -> None:
+        """Stream quiescing (termination probe): push any pending state so
+        hub-side statistics are complete."""
+
+    def toggle(self) -> None:
+        self.paused = not self.paused
+
+
+class HubNode:
+    """Hub-side protocol node owning global protocol state + statistics."""
+
+    def __init__(
+        self,
+        network_id: int,
+        hub_id: int,
+        n_workers: int,
+        n_hubs: int,
+        config: TrainingConfiguration,
+        reply: ReplyFn,
+        broadcast: BroadcastFn,
+    ):
+        self.network_id = network_id
+        self.hub_id = hub_id
+        self.n_workers = n_workers
+        self.n_hubs = n_hubs
+        self.config = config
+        self.reply = reply
+        self.broadcast = broadcast
+        self.stats = Statistics(pipeline=network_id, protocol=config.protocol)
+        self._curve_buffer: list = []
+
+    # --- statistics helpers (byte accounting at the send sites, mirroring
+    # FlinkHub.scala:118-127 / FlinkNetwork getSize calls) ---
+
+    def count_received(self, payload: Any) -> None:
+        self.stats.update_stats(bytes_shipped=payload_size(payload))
+
+    def count_shipped(self, payload: Any, n_dest: int = 1, blocks: int = 1) -> None:
+        self.stats.update_stats(
+            models_shipped=n_dest,
+            bytes_shipped=payload_size(payload) * n_dest,
+            num_of_blocks=blocks,
+        )
+
+    def record_curve(self, slices) -> None:
+        """Accumulate (loss, fitted) learning-curve points pushed by workers
+        (FlinkHub.scala:101-116 extracts these from the PS)."""
+        self.stats.extend_curve(slices)
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        raise NotImplementedError
+
+    def on_terminate(self) -> None:
+        """Final chance to fold state into stats before the job report."""

@@ -1,0 +1,158 @@
+"""CentralizedTraining and SingleLearner protocols.
+
+Reference counterparts (MLNodeGenerator.scala:20-76):
+
+- ``CentralizedTraining`` — ``SingleWorker`` / ``SimplePS``: the parallelism-1
+  fallback, forced whenever job parallelism == 1 (FlinkSpoke.scala:213-215,
+  FlinkHub.scala:186-190). The single worker trains locally; the PS is a
+  passive statistics/model mirror.
+- ``SingleLearner`` — ``ForwardingWorker`` / ``CentralizedMLServer``: workers
+  forward raw tuples; ONE central model lives on the hub; forced for HT and
+  K-means (FlinkSpoke.scala:203-210). The hub periodically ships the model
+  back so workers can serve predictions; the hub exposes ``fitted`` and the
+  learning curve (FlinkHub.scala:128-153).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from omldm_tpu.protocols.base import HubNode, WorkerNode
+from omldm_tpu.runtime.messages import OP_PUSH, OP_UPDATE
+
+
+class SingleWorker(WorkerNode):
+    """Trains locally; ships params + curve slices to the PS every
+    ``syncEvery`` batches (config extra, default 4) for stats/query parity."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.sync_every = int(self.config.extra.get("syncEvery", 4))
+        self._batches = 0
+
+    def _push_state(self) -> None:
+        flat, _ = self.pipeline.get_flat_params()
+        self.send(
+            OP_PUSH,
+            {
+                "params": flat,
+                "curve": self.pipeline.curve_slice(),
+                "fitted": self.pipeline.fitted,
+                "mean_buffer_size": 0.0,
+            },
+            0,
+        )
+
+    def on_training_batch(self, x, y, mask) -> Optional[float]:
+        loss = self.pipeline.fit(x, y, mask)
+        self._batches += 1
+        if self._batches % self.sync_every == 0:
+            self._push_state()
+        return loss
+
+    def on_flush(self) -> None:
+        self._push_state()
+
+
+class SimplePS(HubNode):
+    """Passive PS: stores the latest model snapshot + accumulates stats."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.global_params: Optional[np.ndarray] = None
+        # per-worker fitted watermark: pushes from different workers
+        # interleave, so deltas must be computed per source
+        self._fitted_seen: dict = {}
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op == OP_PUSH:
+            self.count_received(payload)
+            self.global_params = payload["params"]
+            self.record_curve(payload["curve"])
+            delta = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+            self._fitted_seen[worker_id] = payload["fitted"]
+            self.stats.update_fitted(max(delta, 0))
+
+
+class ForwardingWorker(WorkerNode):
+    """Forwards raw training batches to the central hub model; serves
+    predictions with the last model broadcast back by the hub."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._hub_fitted = 0
+        self._hub_cum_loss = 0.0
+
+    def on_training_batch(self, x, y, mask) -> Optional[float]:
+        self.send(OP_PUSH, {"x": x, "y": y, "mask": mask}, 0)
+        return None
+
+    def receive(self, op: str, payload: Any) -> None:
+        if op == OP_UPDATE:
+            # model is the central pipeline state (in-process shared for
+            # host-side models like HT; flat vector otherwise)
+            model = payload["model"]
+            if isinstance(model, np.ndarray):
+                self.pipeline.set_flat_params(model)
+            else:
+                self.pipeline.state["params"] = model
+            self._hub_fitted = payload["fitted"]
+            self._hub_cum_loss = payload["cum_loss"]
+
+    def query_stats(self) -> dict:
+        # the model lives on the hub; report the hub's counters
+        return {
+            "data_fitted": self._hub_fitted,
+            "cumulative_loss": self._hub_cum_loss,
+        }
+
+    def on_flush(self) -> None:
+        pass
+
+
+class CentralizedMLServer(HubNode):
+    """THE model lives here; trains on forwarded tuples.
+
+    Needs a pipeline of its own: the runtime injects it via ``attach_pipeline``
+    right after construction (mirrors generateHub wiring,
+    FlinkHub.scala:166-195)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.pipeline = None
+        self.sync_every = int(self.config.extra.get("syncEvery", 8))
+        self._batches = 0
+
+    def attach_pipeline(self, pipeline) -> None:
+        self.pipeline = pipeline
+
+    def _ship_model(self) -> None:
+        if self.pipeline.learner.host_side:
+            model = self.pipeline.state["params"]  # in-process share
+        else:
+            model, _ = self.pipeline.get_flat_params()
+        payload = {
+            "model": model,
+            "fitted": self.pipeline.fitted,
+            "cum_loss": self.pipeline.cumulative_loss,
+        }
+        self.count_shipped(payload, n_dest=self.n_workers)
+        self.broadcast(OP_UPDATE, payload)
+        # drain the curve incrementally (FlinkHub.scala:101-116) — letting it
+        # grow until terminate would pin device scalars for the whole run
+        self.record_curve(self.pipeline.curve_slice())
+        self.stats.fitted = self.pipeline.fitted
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op == OP_PUSH:
+            self.count_received(payload)
+            self.pipeline.fit(payload["x"], payload["y"], payload["mask"])
+            self._batches += 1
+            if self._batches % self.sync_every == 0:
+                self._ship_model()
+
+    def on_terminate(self) -> None:
+        if self.pipeline is not None:
+            self._ship_model()

@@ -1,0 +1,63 @@
+"""Protocol registry: the 8 protocol keys -> (worker, hub) node classes.
+
+Reference counterpart: ``MLNodeGenerator.generateSpokeNode/generateHubNode``
+protocol dispatch (MLNodeGenerator.scala:20-76), including:
+
+- unknown keys fall back to ``Asynchronous`` (MLNodeGenerator.scala:28,57);
+- ``SingleLearner`` is forced for HT and K-means (FlinkSpoke.scala:203-210);
+- ``CentralizedTraining`` is forced when parallelism == 1
+  (FlinkSpoke.scala:213-215, FlinkHub.scala:186-190).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.learners.registry import SINGLE_LEARNER_ONLY
+from omldm_tpu.protocols.base import HubNode, WorkerNode
+from omldm_tpu.protocols.centralized import (
+    CentralizedMLServer,
+    ForwardingWorker,
+    SimplePS,
+    SingleWorker,
+)
+
+PROTOCOLS: Dict[str, Tuple[Type[WorkerNode], Type[HubNode]]] = {
+    "CentralizedTraining": (SingleWorker, SimplePS),
+    "SingleLearner": (ForwardingWorker, CentralizedMLServer),
+}
+
+
+def register_protocol(name, worker_cls, hub_cls) -> None:
+    PROTOCOLS[name] = (worker_cls, hub_cls)
+
+
+def resolve_protocol(
+    requested: str, learner_name: str, parallelism: int
+) -> str:
+    """Apply the reference's forcing rules, then fall back to Asynchronous
+    for unknown keys."""
+    if learner_name in SINGLE_LEARNER_ONLY:
+        return "SingleLearner"
+    if parallelism == 1 and requested != "SingleLearner":
+        return "CentralizedTraining"
+    if requested not in PROTOCOLS:
+        return "Asynchronous" if "Asynchronous" in PROTOCOLS else "CentralizedTraining"
+    return requested
+
+
+def make_worker_node(
+    protocol: str, pipeline, worker_id: int, n_workers: int,
+    config: TrainingConfiguration, send,
+) -> WorkerNode:
+    worker_cls, _ = PROTOCOLS[protocol]
+    return worker_cls(pipeline, worker_id, n_workers, config, send)
+
+
+def make_hub_node(
+    protocol: str, network_id: int, hub_id: int, n_workers: int, n_hubs: int,
+    config: TrainingConfiguration, reply, broadcast,
+) -> HubNode:
+    _, hub_cls = PROTOCOLS[protocol]
+    return hub_cls(network_id, hub_id, n_workers, n_hubs, config, reply, broadcast)

@@ -1,0 +1,6 @@
+"""Host-side stream runtime: the TPU-native replacement for the reference's
+Flink operator graph (SURVEY.md section 1 layers L1/L2/L5)."""
+
+from omldm_tpu.runtime.job import StreamJob
+
+__all__ = ["StreamJob"]

@@ -1,0 +1,74 @@
+"""Control plane: request validation and pipeline bookkeeping.
+
+Reference counterpart: ``PipelineMap`` (PipelineMap.scala:14-71) — a
+parallelism-1 gatekeeper that validates learner/preprocessor names against
+allowlists (ValidLists, PipelineMap.scala:66-69), maintains the map of live
+pipelines, broadcasts Create/Update/Delete to every worker, and routes Query
+to worker 0 only for single-learner models (PipelineMap.scala:37-42).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.learners.registry import SINGLE_LEARNER_ONLY, is_valid_learner
+from omldm_tpu.preprocessors.registry import is_valid_preprocessor
+
+
+class PipelineManager:
+    """Validates and routes control requests; parallelism-1 by design."""
+
+    def __init__(self) -> None:
+        self.node_map: Dict[int, Request] = {}
+
+    def validate(self, request: Request) -> Optional[str]:
+        """Returns an error string, or None if the request is acceptable
+        (the reference silently drops invalid requests after a println,
+        PipelineMap.scala:34,46)."""
+        if request.request == RequestType.CREATE:
+            if request.id in self.node_map:
+                return f"pipeline {request.id} already exists"
+            if request.learner is None:
+                return "create request without learner"
+            if not is_valid_learner(request.learner.name):
+                return f"unknown learner {request.learner.name!r}"
+            for p in request.preprocessors:
+                if not is_valid_preprocessor(p.name):
+                    return f"unknown preprocessor {p.name!r}"
+            if request.training_configuration.hub_parallelism < 1:
+                return "HubParallelism must be >= 1"
+            return None
+        if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
+            if request.id not in self.node_map:
+                return f"pipeline {request.id} does not exist"
+            if request.request == RequestType.UPDATE:
+                if request.learner is None or not is_valid_learner(request.learner.name):
+                    return "invalid update learner"
+            return None
+        return f"unknown request type {request.request}"
+
+    def admit(self, request: Request) -> bool:
+        """Validate + update the live map; True if the request should be
+        broadcast to workers."""
+        if self.validate(request) is not None:
+            return False
+        if request.request in (RequestType.CREATE, RequestType.UPDATE):
+            self.node_map[request.id] = request
+        elif request.request == RequestType.DELETE:
+            del self.node_map[request.id]
+        return True
+
+    def query_targets(self, request: Request, parallelism: int) -> List[int]:
+        """Worker ids a Query goes to: worker 0 only for single-learner
+        models, else all workers (PipelineMap.scala:37-42)."""
+        live = self.node_map.get(request.id)
+        if live is not None and live.learner is not None and (
+            live.learner.name in SINGLE_LEARNER_ONLY
+        ):
+            return [0]
+        return list(range(parallelism))
+
+    @property
+    def live_pipelines(self) -> List[int]:
+        return sorted(self.node_map)

@@ -1,0 +1,58 @@
+"""Bounded FIFO data buffers.
+
+Reference counterpart: ``mlAPI.dataBuffers.DataSet[T](maxSize)`` with
+``append -> Option[evicted]``, ``pop``, ``merge``, ``length`` etc.
+(FlinkSpoke.scala:41,96-98,309-330, SpokeLogic.scala:32-35). Used for the
+sliding holdout test set, the pre-creation record/request buffers, and the
+hub's pre-creation message cache.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class DataSet(Generic[T]):
+    def __init__(self, max_size: int):
+        self.max_size = max_size
+        self._buf: Deque[T] = deque()
+
+    def append(self, item: T) -> Optional[T]:
+        """Append; returns the evicted oldest item when full (the reference
+        trains on evicted holdout points, FlinkSpoke.scala:96-104)."""
+        evicted = None
+        if len(self._buf) >= self.max_size:
+            evicted = self._buf.popleft()
+        self._buf.append(item)
+        return evicted
+
+    def pop(self) -> Optional[T]:
+        return self._buf.popleft() if self._buf else None
+
+    def merge(self, others: Iterable["DataSet[T]"]) -> None:
+        """Interleaved merge of parallel buffers (CommonUtils.scala:36-48);
+        overflow beyond max_size is returned to the caller via extract_overflow
+        semantics — here we simply keep the newest items."""
+        merged: List[T] = list(self._buf)
+        for other in others:
+            merged.extend(other._buf)
+        self._buf = deque(merged[-self.max_size :])
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._buf
+
+    def __iter__(self):
+        return iter(self._buf)
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    def to_list(self) -> List[T]:
+        return list(self._buf)

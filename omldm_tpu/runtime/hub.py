@@ -1,0 +1,146 @@
+"""Hub: the parameter-server-side runtime.
+
+Reference counterpart: ``FlinkHub`` + ``HubLogic`` (FlinkHub.scala:25-197,
+HubLogic.scala:15-35): one keyed instance per (networkId, hubId); worker
+messages arriving before hub creation are cached (20_000-message DataSet,
+FlinkHub.scala:70-87) and drained after creation; in test mode the hub
+extracts per-hub ``Statistics`` including incremental learning-curve slices
+from the PS (FlinkHub.scala:88-157).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from omldm_tpu.api.requests import Request
+from omldm_tpu.api.stats import Statistics
+from omldm_tpu.config import JobConfig
+from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.protocols.centralized import CentralizedMLServer
+from omldm_tpu.protocols.registry import make_hub_node, resolve_protocol
+from omldm_tpu.runtime.databuffers import DataSet
+
+
+class Hub:
+    """One (networkId, hubId) parameter-server shard."""
+
+    def __init__(
+        self,
+        network_id: int,
+        hub_id: int,
+        request: Request,
+        dim: int,
+        config: JobConfig,
+        reply: Callable,       # (worker_id, op, payload)
+        broadcast: Callable,   # (op, payload)
+    ):
+        self.network_id = network_id
+        self.hub_id = hub_id
+        tc = request.training_configuration
+        protocol = resolve_protocol(
+            tc.protocol, request.learner.name, config.parallelism
+        )
+        self.protocol = protocol
+        self.node = make_hub_node(
+            protocol,
+            network_id,
+            hub_id,
+            config.parallelism,
+            tc.hub_parallelism,
+            tc,
+            reply,
+            broadcast,
+        )
+        # stats carry the resolved protocol, not the requested one (the
+        # forcing rules of FlinkSpoke.scala:203-215 may have overridden it)
+        self.node.stats.protocol = protocol
+        # SingleLearner: the central model lives here (FlinkHub.scala:128-153)
+        if isinstance(self.node, CentralizedMLServer):
+            self.node.attach_pipeline(
+                MLPipeline(
+                    request.learner,
+                    request.preprocessors,
+                    dim=dim,
+                    rng=jax.random.PRNGKey(request.id),
+                    per_record=tc.per_record,
+                )
+            )
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        self.node.receive(worker_id, op, payload)
+
+    def statistics(self) -> Statistics:
+        return self.node.stats
+
+    def on_terminate(self) -> None:
+        self.node.on_terminate()
+
+
+class HubManager:
+    """Routes worker->hub traffic; caches messages that beat hub creation
+    (FlinkHub.scala:70-87, StateAccumulators.scala:128-146)."""
+
+    def __init__(self, config: JobConfig, reply_to_spoke: Callable):
+        self.config = config
+        self.hubs: Dict[Tuple[int, int], Hub] = {}
+        self._reply_to_spoke = reply_to_spoke  # (network_id, worker_id, op, payload)
+        self._pre_creation: Dict[Tuple[int, int], DataSet] = {}
+
+    def create_hub(self, request: Request, hub_id: int, dim: int) -> Hub:
+        key = (request.id, hub_id)
+        if key in self.hubs:
+            return self.hubs[key]
+        net_id = request.id
+
+        def reply(worker_id: int, op: str, payload: Any) -> None:
+            self._reply_to_spoke(net_id, worker_id, op, payload)
+
+        def broadcast(op: str, payload: Any) -> None:
+            for w in range(self.config.parallelism):
+                self._reply_to_spoke(net_id, w, op, payload)
+
+        hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
+        self.hubs[key] = hub
+        # drain the pre-creation cache (FlinkHub.scala:70-87)
+        cached = self._pre_creation.pop(key, None)
+        if cached is not None:
+            for worker_id, op, payload in cached:
+                hub.receive(worker_id, op, payload)
+        return hub
+
+    def delete_network(self, network_id: int) -> None:
+        for key in [k for k in self.hubs if k[0] == network_id]:
+            del self.hubs[key]
+        for key in [k for k in self._pre_creation if k[0] == network_id]:
+            del self._pre_creation[key]
+
+    def route(
+        self, network_id: int, hub_id: int, worker_id: int, op: str, payload: Any
+    ) -> None:
+        hub = self.hubs.get((network_id, hub_id))
+        if hub is None:
+            cache = self._pre_creation.setdefault(
+                (network_id, hub_id), DataSet(self.config.hub_cache_cap)
+            )
+            cache.append((worker_id, op, payload))
+            return
+        hub.receive(worker_id, op, payload)
+
+    def network_statistics(self, network_id: int) -> Optional[Statistics]:
+        """Merged cross-hub statistics for one pipeline
+        (StateAccumulators.scala:54-126)."""
+        stats = [
+            h.statistics() for (nid, _), h in self.hubs.items() if nid == network_id
+        ]
+        if not stats:
+            return None
+        merged = stats[0]
+        for s in stats[1:]:
+            merged = merged.merge(s)
+        return merged
+
+    def on_terminate(self) -> None:
+        for hub in self.hubs.values():
+            hub.on_terminate()

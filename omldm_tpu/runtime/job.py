@@ -1,0 +1,261 @@
+"""StreamJob: assembles spokes, hubs, control plane, statistics and sinks.
+
+Reference counterpart: ``Job`` + ``FlinkLearning`` (Job.scala:28-171,
+FlinkLearning.scala:33-152) — the dataflow graph of SURVEY.md section 1:
+training/forecasting sources -> parsers -> workers; requests -> gatekeeper ->
+broadcast; worker<->PS protocol traffic (the reference's Kafka ``psMessages``
+feedback loop, Job.scala:76-87, replaced by in-process routing / ICI
+collectives); predictions, merged query responses, and final job statistics
+out.
+
+The job consumes an ordered event iterable (file replay, in-process queues, or
+a Kafka consumer adapter) — the deterministic equivalent of the reference's
+Kafka sources, with the same termination protocol driven by a silence timer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from omldm_tpu.api.data import FORECASTING, TRAINING, DataInstance, Prediction
+from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
+from omldm_tpu.api.stats import JobStatistics
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime.control import PipelineManager
+from omldm_tpu.runtime.hub import HubManager
+from omldm_tpu.runtime.responses import ResponseMerger
+from omldm_tpu.runtime.spoke import Spoke
+from omldm_tpu.runtime.stats import StatisticsCollector
+from omldm_tpu.runtime.vectorizer import Vectorizer
+
+# event stream names (the reference's Kafka topics, README.md:21-26)
+TRAINING_STREAM = "trainingData"
+FORECASTING_STREAM = "forecastingData"
+REQUEST_STREAM = "requests"
+
+
+class StreamJob:
+    def __init__(
+        self,
+        config: Optional[JobConfig] = None,
+        on_prediction: Optional[Callable[[Prediction], None]] = None,
+        on_response: Optional[Callable[[QueryResponse], None]] = None,
+        on_performance: Optional[Callable[[JobStatistics], None]] = None,
+    ):
+        self.config = config or JobConfig()
+        self.predictions: List[Prediction] = []
+        self.responses: List[QueryResponse] = []
+        self.performance: List[JobStatistics] = []
+        self._on_prediction = on_prediction
+        self._on_response = on_response
+        self._on_performance = on_performance
+
+        self.pipeline_manager = PipelineManager()
+        self.stats = StatisticsCollector(self.config, self._emit_performance)
+        self.response_merger = ResponseMerger(self._emit_response)
+        self.hub_manager = HubManager(self.config, self._reply_to_spoke)
+        self.spokes: List[Spoke] = [
+            Spoke(
+                worker_id=i,
+                config=self.config,
+                send_to_hub=self.hub_manager.route,
+                emit_prediction=self._emit_prediction,
+                emit_response=self._route_response_fragment,
+                on_poll=self.stats.mark_activity,
+            )
+            for i in range(self.config.parallelism)
+        ]
+        self._rr = 0  # round-robin data partitioner (the reference rebalances)
+        self._pending_creates: List[Request] = []  # awaiting dim inference
+        self._dims: dict = {}  # network_id -> feature dim
+
+    # --- sinks ---
+
+    def _emit_prediction(self, pred: Prediction) -> None:
+        self.predictions.append(pred)
+        if self._on_prediction:
+            self._on_prediction(pred)
+
+    def _emit_response(self, resp: QueryResponse) -> None:
+        self.responses.append(resp)
+        if self._on_response:
+            self._on_response(resp)
+
+    def _emit_performance(self, report: JobStatistics) -> None:
+        self.performance.append(report)
+        if self._on_performance:
+            self._on_performance(report)
+
+    def _route_response_fragment(self, frag: QueryResponse) -> None:
+        """responseId -1 fragments are termination stats, everything else is
+        a user query fragment (FlinkLearning.scala:115-133)."""
+        if frag.response_id == TERMINATION_RESPONSE_ID:
+            self.stats.add_terminate_fragment(frag)
+        else:
+            self.response_merger.add_fragment(frag)
+
+    def _reply_to_spoke(
+        self, network_id: int, worker_id: int, op: str, payload: Any
+    ) -> None:
+        self.spokes[worker_id].receive_from_hub(network_id, op, payload)
+
+    # --- event handling ---
+
+    def process_event(self, stream: str, payload: Any) -> None:
+        if self.stats.terminated:
+            return
+        if stream == REQUEST_STREAM:
+            request = (
+                payload if isinstance(payload, Request) else Request.from_json(payload)
+            )
+            if request is not None:
+                self._handle_request(request)
+        elif stream in (TRAINING_STREAM, FORECASTING_STREAM):
+            inst = (
+                payload
+                if isinstance(payload, DataInstance)
+                else DataInstance.from_json(payload)
+            )
+            if inst is not None:
+                if stream == FORECASTING_STREAM:
+                    inst.operation = FORECASTING
+                self._handle_data(inst)
+
+    def _handle_request(self, request: Request) -> None:
+        self.stats.mark_activity()
+        if not self.pipeline_manager.admit(request):
+            return
+        if request.request in (RequestType.CREATE, RequestType.UPDATE):
+            dim = self._request_dim(request)
+            if dim is None:
+                # an Update reuses the live pipeline's dim
+                dim = self._dims.get(request.id)
+            if dim is None:
+                # a record already buffered in a spoke can pin the dim
+                dim = self._infer_dim_from_buffers(request)
+            if dim is None:
+                self._pending_creates.append(request)
+                return
+            self._deploy(request, dim)
+        elif request.request == RequestType.DELETE:
+            for spoke in self.spokes:
+                spoke.handle_request(request, 0)
+            self.hub_manager.delete_network(request.id)
+            self._dims.pop(request.id, None)
+            # a pipeline deleted before dim inference must not resurrect
+            self._pending_creates = [
+                r for r in self._pending_creates if r.id != request.id
+            ]
+        elif request.request == RequestType.QUERY:
+            if request.id not in self._dims:
+                # pipeline admitted but not deployed yet (awaiting dim
+                # inference): no worker hosts it, so no fragments would ever
+                # arrive — drop the query instead of leaking an expectation
+                return
+            targets = self.pipeline_manager.query_targets(
+                request, self.config.parallelism
+            )
+            rid = request.request_id if request.request_id is not None else 0
+            self.response_merger.expect(rid, len(targets))
+            for w in targets:
+                self.spokes[w].handle_request(request, self._dims.get(request.id, 0))
+
+    def _infer_dim_from_buffers(self, request: Request) -> Optional[int]:
+        hash_dims = int(request.training_configuration.extra.get("hashDims", 0))
+        for spoke in self.spokes:
+            for inst in spoke.record_buffer:
+                return Vectorizer.infer_dim(inst, hash_dims)
+        return None
+
+    def _request_dim(self, request: Request) -> Optional[int]:
+        """Feature dim from the request's dataStructure (nFeatures), else None
+        => deferred until the first data record arrives (the reference sizes
+        models lazily on first record)."""
+        ds = request.learner.data_structure if request.learner else None
+        if ds and "nFeatures" in ds:
+            return int(ds["nFeatures"]) + int(
+                request.training_configuration.extra.get("hashDims", 0)
+            )
+        return None
+
+    def _deploy(self, request: Request, dim: int) -> None:
+        """Create the pipeline on every worker and its hub shard(s) —
+        the reference broadcasts a ControlMessage per worker
+        (PipelineMap.scala:54-57) and spoke 0 creates each of the
+        hubParallelism hubs (FlinkSpoke.scala:220-222)."""
+        # an Update must rebuild the hub side too (protocol/learner/dim may
+        # have changed); create_hub is a no-op for existing keys otherwise
+        if request.id in self._dims:
+            self.hub_manager.delete_network(request.id)
+        self._dims[request.id] = dim
+        for spoke in self.spokes:
+            spoke.handle_request(request, dim)
+        for h in range(request.training_configuration.hub_parallelism):
+            self.hub_manager.create_hub(request, h, dim)
+
+    def _handle_data(self, inst: DataInstance) -> None:
+        self.stats.mark_activity()
+        if self._pending_creates:
+            pending, self._pending_creates = self._pending_creates, []
+            for request in pending:
+                hash_dims = int(
+                    request.training_configuration.extra.get("hashDims", 0)
+                )
+                dim = Vectorizer.infer_dim(inst, hash_dims)
+                self._deploy(request, dim)
+        spoke = self.spokes[self._rr % len(self.spokes)]
+        self._rr += 1
+        spoke.handle_data(inst)
+
+    # --- run loops ---
+
+    def run(
+        self,
+        events: Iterable[Tuple[str, Any]],
+        terminate_on_end: bool = True,
+    ) -> Optional[JobStatistics]:
+        """Replay an ordered event stream; fires the termination protocol at
+        stream end (the deterministic equivalent of the 30 s silence timer)."""
+        for stream, payload in events:
+            if self.stats.terminated:
+                break
+            self.process_event(stream, payload)
+        if terminate_on_end and not self.stats.terminated:
+            return self.terminate()
+        return self.performance[-1] if self.performance else None
+
+    def check_silence(self, now: Optional[float] = None) -> Optional[JobStatistics]:
+        """Live-mode hook: fire the termination probe when the silence
+        timeout elapsed (StatisticsOperator.scala:135-142)."""
+        if self.stats.silence_exceeded(now):
+            return self.terminate()
+        return None
+
+    def terminate(self) -> Optional[JobStatistics]:
+        """The section 3.5 termination protocol: probe every worker, fold hub
+        state, count fragments, normalize, emit JobStatistics."""
+        if self.stats.terminated:
+            return self.performance[-1] if self.performance else None
+        self.stats.probe_fired = True
+        for spoke in self.spokes:
+            spoke.handle_terminate_probe()
+        self.hub_manager.on_terminate()
+        for net_id in self.pipeline_manager.live_pipelines:
+            merged = self.hub_manager.network_statistics(net_id)
+            if merged is not None:
+                merged.normalize(
+                    max(
+                        len(
+                            [
+                                k
+                                for k in self.hub_manager.hubs
+                                if k[0] == net_id
+                            ]
+                        ),
+                        1,
+                    )
+                )
+                self.stats.add_hub_statistics(net_id, merged)
+        return self.stats.try_finalize(len(self.pipeline_manager.live_pipelines))

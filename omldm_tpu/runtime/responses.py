@@ -1,0 +1,56 @@
+"""Query-response re-assembly across workers.
+
+Reference counterpart: ``ResponseConstructor`` (ResponseConstructor.scala:13-69)
+— collects one ``QueryResponse`` fragment per worker (keyed by responseId),
+then merges: keeps the last non-null learner/preprocessors/protocol, sums
+``dataFitted``, averages loss/cumulativeLoss/score over parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from omldm_tpu.api.responses import QueryResponse
+
+
+class ResponseMerger:
+    def __init__(self, emit: Callable[[QueryResponse], None]):
+        self._emit = emit
+        self._pending: Dict[int, List[QueryResponse]] = {}
+        self._expected: Dict[int, int] = {}
+
+    def expect(self, response_id: int, n_fragments: int) -> None:
+        self._expected[response_id] = n_fragments
+
+    def add_fragment(self, fragment: QueryResponse) -> Optional[QueryResponse]:
+        rid = fragment.response_id
+        frags = self._pending.setdefault(rid, [])
+        frags.append(fragment)
+        expected = self._expected.get(rid, 1)
+        if len(frags) < expected:
+            return None
+        del self._pending[rid]
+        self._expected.pop(rid, None)
+        merged = self._merge(frags)
+        self._emit(merged)
+        return merged
+
+    @staticmethod
+    def _merge(frags: List[QueryResponse]) -> QueryResponse:
+        n = len(frags)
+        out = QueryResponse(
+            response_id=frags[0].response_id,
+            mlp_id=frags[0].mlp_id,
+        )
+        for f in frags:
+            if f.learner is not None:
+                out.learner = f.learner
+            if f.preprocessors is not None:
+                out.preprocessors = f.preprocessors
+            if f.protocol is not None:
+                out.protocol = f.protocol
+            out.data_fitted += f.data_fitted
+        out.loss = sum((f.loss or 0.0) for f in frags) / n
+        out.cumulative_loss = sum((f.cumulative_loss or 0.0) for f in frags) / n
+        out.score = sum((f.score or 0.0) for f in frags) / n
+        return out

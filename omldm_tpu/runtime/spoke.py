@@ -1,0 +1,259 @@
+"""Spoke: the worker-side runtime hosting pipeline replicas.
+
+Reference counterpart: ``FlinkSpoke`` + ``SpokeLogic``
+(FlinkSpoke.scala:28-356, SpokeLogic.scala:20-59): hosts one node per
+pipeline in ``state: Map[Int, BufferingWrapper]``, fans every data point out
+to all hosted pipelines, runs the 20% holdout sampling (counts 8,9 of each
+0-9 cycle into a sliding ``testSet``; evicted points get trained —
+FlinkSpoke.scala:94-104), emits a poll marker every 100 training records
+(FlinkSpoke.scala:83-89), dispatches control messages, and buffers records/
+requests arriving before pipeline creation (caps 100_000 / 10_000,
+SpokeLogic.scala:31-35).
+
+TPU redesign: records are vectorized host-side and accumulated into
+fixed-shape micro-batches per pipeline; the per-batch fit is the jitted
+pipeline step. Forecasting records are answered immediately through a
+fixed-width padded predict batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from omldm_tpu.api.data import FORECASTING, DataInstance, Prediction
+from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
+from omldm_tpu.config import JobConfig
+from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
+from omldm_tpu.runtime.databuffers import DataSet
+from omldm_tpu.runtime.messages import OP_PUSH
+from omldm_tpu.runtime.vectorizer import MicroBatcher, Vectorizer
+
+# width of the immediate-serving predict batch (forecasting records are padded
+# into this fixed shape so the predict jit never recompiles)
+PREDICT_BATCH = 16
+
+
+class SpokeNet:
+    """Per-(spoke, networkId) state: worker node + batcher + holdout set."""
+
+    def __init__(
+        self,
+        request: Request,
+        worker_id: int,
+        n_workers: int,
+        dim: int,
+        config: JobConfig,
+        send,
+    ):
+        self.request = request
+        self.dim = dim
+        tc = request.training_configuration
+        self.protocol = resolve_protocol(
+            tc.protocol, request.learner.name, n_workers
+        )
+        hash_dims = int(tc.extra.get("hashDims", 0))
+        self.vectorizer = Vectorizer(dim, hash_dims)
+        batch = int(tc.mini_batch_size or config.batch_size)
+        pipeline = MLPipeline(
+            request.learner,
+            request.preprocessors,
+            dim=dim,
+            rng=jax.random.PRNGKey(request.id),
+            per_record=tc.per_record,
+        )
+        self.node = make_worker_node(
+            self.protocol, pipeline, worker_id, n_workers, tc, send
+        )
+        self.batcher = MicroBatcher(dim, batch)
+        self.test_set: DataSet[Tuple[np.ndarray, float]] = DataSet(
+            config.test_set_size
+        )
+        self.holdout_count = 0
+
+    @property
+    def pipeline(self) -> MLPipeline:
+        return self.node.pipeline
+
+    def flush_batch(self) -> None:
+        flushed = self.batcher.flush()
+        if flushed is not None:
+            x, y, mask = flushed
+            self.node.on_training_batch(x, y, mask)
+
+    def test_arrays(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        if self.test_set.is_empty:
+            return None
+        pts = self.test_set.to_list()
+        x = np.stack([p[0] for p in pts])
+        y = np.asarray([p[1] for p in pts], np.float32)
+        return x, y, np.ones((len(pts),), np.float32)
+
+
+class Spoke:
+    """One logical worker (a Flink subtask in the reference)."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        config: JobConfig,
+        send_to_hub: Callable,   # (network_id, hub_id, worker_id, op, payload)
+        emit_prediction: Callable[[Prediction], None],
+        emit_response: Callable[[QueryResponse], None],
+        on_poll: Callable[[], None],
+    ):
+        self.worker_id = worker_id
+        self.config = config
+        self.nets: Dict[int, SpokeNet] = {}
+        self._send_to_hub = send_to_hub
+        self._emit_prediction = emit_prediction
+        self._emit_response = emit_response
+        self._on_poll = on_poll
+        # pre-creation buffering (SpokeLogic.scala:31-35)
+        self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
+        self._poll_counter = 0
+
+    # --- control path (FlinkSpoke.processElement2) ---
+
+    def handle_request(self, request: Request, dim: int) -> None:
+        if request.request == RequestType.CREATE:
+            self._create(request, dim)
+        elif request.request == RequestType.UPDATE:
+            self._delete(request.id)
+            self._create(request, dim)
+        elif request.request == RequestType.DELETE:
+            self._delete(request.id)
+        elif request.request == RequestType.QUERY:
+            self._query(request)
+
+    def _create(self, request: Request, dim: int) -> None:
+        if request.id in self.nets:
+            return
+        net = SpokeNet(
+            request,
+            self.worker_id,
+            self.config.parallelism,
+            dim,
+            self.config,
+            self._make_send(request.id),
+        )
+        self.nets[request.id] = net
+        net.node.on_start()
+        # drain buffered records (FlinkSpoke.scala:69-80)
+        if len(self.record_buffer):
+            buffered = self.record_buffer.to_list()
+            self.record_buffer.clear()
+            for inst in buffered:
+                self.handle_data(inst)
+
+    def _delete(self, network_id: int) -> None:
+        self.nets.pop(network_id, None)
+
+    def _make_send(self, network_id: int):
+        def send(op: str, payload: Any, hub_id: int = 0) -> None:
+            self._send_to_hub(network_id, hub_id, self.worker_id, op, payload)
+
+        return send
+
+    # --- data path (FlinkSpoke.processElement1 / handleData) ---
+
+    def handle_data(self, inst: DataInstance) -> None:
+        if not self.nets:
+            self.record_buffer.append(inst)
+            return
+        for net in self.nets.values():
+            if net.node.paused:
+                continue
+            x = net.vectorizer.vectorize(inst)
+            if inst.operation == FORECASTING:
+                self._serve(net, inst, x)
+            else:
+                self._train(net, x, 0.0 if inst.target is None else inst.target)
+        if inst.operation != FORECASTING:
+            # poll marker every 100 training records — once per record, not
+            # per hosted pipeline (FlinkSpoke.scala:83-89)
+            self._poll_counter += 1
+            if self.config.test and self._poll_counter % self.config.poll_every == 0:
+                self._on_poll()
+
+    def _train(self, net: SpokeNet, x: np.ndarray, y: float) -> None:
+        # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
+        c = net.holdout_count % 10
+        net.holdout_count += 1
+        if self.config.test and c >= 8:
+            evicted = net.test_set.append((x, y))
+            if evicted is None:
+                return
+            x, y = evicted
+        net.batcher.add(x, y)
+        if net.batcher.full:
+            net.flush_batch()
+
+    def _serve(self, net: SpokeNet, inst: DataInstance, x: np.ndarray) -> None:
+        xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+        xb[0] = x
+        preds = net.node.on_forecast_batch(xb)
+        self._emit_prediction(
+            Prediction(net.request.id, inst, float(preds[0]))
+        )
+
+    # --- query / termination (FlinkSpoke.scala:136-171) ---
+
+    def _query(self, request: Request) -> None:
+        net = self.nets.get(request.id)
+        if net is None:
+            return
+        self.emit_query_response(
+            net, request.request_id if request.request_id is not None else 0
+        )
+
+    def emit_query_response(self, net: SpokeNet, response_id: int) -> None:
+        """Evaluate on the holdout set and emit one QueryResponse fragment
+        (merged across workers by the ResponseMerger); model parameters are
+        bucketed by the network layer."""
+        net.flush_batch()
+        test = net.test_arrays()
+        if test is not None:
+            loss, score = net.pipeline.evaluate(*test)
+        else:
+            loss, score = 0.0, 0.0
+        desc = net.pipeline.describe()
+        qstats = net.node.query_stats()
+        self._emit_response(
+            QueryResponse(
+                response_id=response_id,
+                mlp_id=net.request.id,
+                preprocessors=desc["preprocessors"],
+                learner=desc["learner"],
+                protocol=net.protocol,
+                data_fitted=qstats["data_fitted"],
+                loss=loss,
+                cumulative_loss=qstats["cumulative_loss"],
+                score=score,
+            )
+        )
+
+    def handle_terminate_probe(self) -> None:
+        """Termination probe: flush + evaluate every net, emit responseId -1
+        fragments (FlinkSpoke.scala:136-138, FlinkLearning.scala:115-133) and
+        let worker nodes push final state."""
+        for net in self.nets.values():
+            net.flush_batch()
+            net.node.on_flush()
+            self.emit_query_response(net, TERMINATION_RESPONSE_ID)
+
+    def receive_from_hub(self, network_id: int, op: str, payload: Any) -> None:
+        net = self.nets.get(network_id)
+        if net is not None:
+            net.node.receive(op, payload)
+
+    def mean_buffer_size(self) -> float:
+        """getMeanBufferSize analogue (FlinkSpoke.scala:138): mean pending
+        (unfitted) records across hosted pipelines."""
+        if not self.nets:
+            return 0.0
+        return float(np.mean([len(net.batcher) for net in self.nets.values()]))

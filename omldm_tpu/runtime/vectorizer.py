@@ -1,0 +1,101 @@
+"""Record featurization and fixed-shape micro-batch assembly.
+
+Reference counterpart: ``DataPointParser`` turning ``DataInstance`` into
+``TrainingPoint``/``ForecastingPoint`` with numerical/discrete/categorical
+vectors (DataPointParser.scala:16-54). The reference keeps per-record objects;
+the TPU runtime instead assembles fixed-shape padded micro-batches so the
+jitted step never recompiles (SURVEY.md section 7 hard part (d)).
+
+Categorical (string) features are feature-hashed into ``hash_dims`` buckets
+host-side — the TPU-native equivalent of the reference's categorical encoding
+(and the "hashed features" of BASELINE.md config 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from omldm_tpu.api.data import DataInstance
+
+
+@dataclasses.dataclass
+class Vectorizer:
+    """Maps DataInstances to fixed-dim float32 vectors.
+
+    ``dim`` is the total feature width the pipeline was created with; records
+    with fewer features are zero-padded, longer ones truncated (the runtime
+    boundary enforcing what the kernel layer asserts via shape errors).
+    ``hash_dims`` > 0 reserves that many trailing dims for hashed categorical
+    features."""
+
+    dim: int
+    hash_dims: int = 0
+
+    def vectorize(self, inst: DataInstance) -> np.ndarray:
+        out = np.zeros((self.dim,), np.float32)
+        pos = 0
+        dense_budget = self.dim - self.hash_dims
+        for feats in (inst.numerical_features, inst.discrete_features):
+            if feats:
+                take = min(len(feats), dense_budget - pos)
+                if take > 0:
+                    out[pos : pos + take] = np.asarray(feats[:take], np.float32)
+                    pos += take
+        if self.hash_dims > 0 and inst.categorical_features:
+            base = self.dim - self.hash_dims
+            for i, cat in enumerate(inst.categorical_features):
+                # stable hash: Python's builtin hash() is salted per process,
+                # which would scramble buckets across checkpoint/restore
+                h = zlib.crc32(f"{i}={cat}".encode())
+                idx = base + (h % self.hash_dims)
+                # signed hashing keeps the estimate unbiased
+                out[idx] += 1.0 if (h >> 1) % 2 == 0 else -1.0
+        return out
+
+    @staticmethod
+    def infer_dim(inst: DataInstance, hash_dims: int = 0) -> int:
+        """Feature width implied by the first record of a stream."""
+        n = len(inst.numerical_features or []) + len(inst.discrete_features or [])
+        return n + hash_dims
+
+
+class MicroBatcher:
+    """Accumulates vectorized records into fixed-shape (x, y, mask) batches.
+
+    ``flush`` pads the ragged tail with zero rows and a zero mask — masked
+    rows contribute nothing to learner updates (see learners.base)."""
+
+    def __init__(self, dim: int, batch_size: int):
+        self.batch_size = batch_size
+        self._x = np.zeros((batch_size, dim), np.float32)
+        self._y = np.zeros((batch_size,), np.float32)
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def full(self) -> bool:
+        return self._n >= self.batch_size
+
+    def add(self, x: np.ndarray, y: float) -> None:
+        self._x[self._n] = x
+        self._y[self._n] = y
+        self._n += 1
+
+    def flush(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Return the padded (x, y, mask) batch and reset; None if empty."""
+        if self._n == 0:
+            return None
+        mask = np.zeros((self.batch_size,), np.float32)
+        mask[: self._n] = 1.0
+        x = self._x.copy()
+        y = self._y.copy()
+        x[self._n :] = 0.0
+        y[self._n :] = 0.0
+        self._n = 0
+        return x, y, mask

@@ -1,0 +1,221 @@
+"""End-to-end runtime tests: the reference's full operating loop — create via
+request stream, train on a JSON record stream, serve forecasts, query models,
+and terminate with final JobStatistics (SURVEY.md sections 3.2-3.5)."""
+
+import json
+
+import numpy as np
+
+from omldm_tpu.api import DataInstance, Request
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.ingest import interleave, memory_events
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+)
+
+
+def make_stream(n, dim=8, seed=0):
+    """Synthetic HIGGS-like binary classification JSON stream."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim).astype(np.float64)
+    y = (x @ w > 0).astype(np.float64)
+    lines = [
+        json.dumps(
+            {
+                "numericalFeatures": list(np.round(x[i], 5)),
+                "target": float(y[i]),
+                "operation": "training",
+            }
+        )
+        for i in range(n)
+    ]
+    return lines, x, y, w
+
+
+CREATE = {
+    "id": 0,
+    "request": "Create",
+    "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+    "preProcessors": [],
+    "trainingConfiguration": {"protocol": "CentralizedTraining"},
+}
+
+
+class TestCentralizedEndToEnd:
+    def test_full_lifecycle(self):
+        cfg = JobConfig(parallelism=1, batch_size=64, test_set_size=64)
+        job = StreamJob(cfg)
+        lines, x, y, w = make_stream(4000)
+        events = [(REQUEST_STREAM, json.dumps(CREATE))] + [
+            (TRAINING_STREAM, l) for l in lines
+        ]
+        report = job.run(events)
+        # termination emitted one JobStatistics with one pipeline entry
+        assert report is not None
+        assert job.stats.terminated
+        [stats] = report.statistics
+        assert stats.pipeline == 0
+        assert stats.protocol == "CentralizedTraining"
+        # 20% holdout: roughly 80% trained (holdout set keeps 64, evictions
+        # get trained)
+        assert stats.fitted > 2500
+        assert stats.score > 0.85  # learned the stream
+        assert stats.bytes_shipped > 0  # model pushes were accounted
+        assert len(stats.learning_curve) > 0
+        assert report.duration_ms >= 0
+
+    def test_forecasting_emits_predictions(self):
+        cfg = JobConfig(parallelism=1, batch_size=32, test_set_size=32)
+        job = StreamJob(cfg)
+        lines, x, y, w = make_stream(1500, dim=4)
+        fore = [
+            json.dumps({"id": i, "numericalFeatures": list(np.round(x[i], 5))})
+            for i in range(200)
+        ]
+        events = (
+            [(REQUEST_STREAM, json.dumps(CREATE))]
+            + [(TRAINING_STREAM, l) for l in lines]
+            + [(FORECASTING_STREAM, l) for l in fore]
+        )
+        job.run(events)
+        assert len(job.predictions) == 200
+        # predictions should correlate with the true labels
+        preds = np.array([p.value for p in job.predictions])
+        signed = y[:200] * 2 - 1
+        acc = float((preds == signed).mean())
+        assert acc > 0.8
+
+    def test_query_merges_fragments(self):
+        cfg = JobConfig(parallelism=4, batch_size=32, test_set_size=32)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(2000, dim=4)
+        query = {"id": 0, "request": "Query", "requestId": 7}
+        events = (
+            [(REQUEST_STREAM, json.dumps(CREATE))]
+            + [(TRAINING_STREAM, l) for l in lines]
+            + [(REQUEST_STREAM, json.dumps(query))]
+        )
+        job.run(events, terminate_on_end=False)
+        # one merged response from 4 worker fragments
+        assert len(job.responses) == 1
+        resp = job.responses[0]
+        assert resp.response_id == 7
+        assert resp.mlp_id == 0
+        assert resp.learner["name"] == "PA"
+        assert resp.data_fitted > 0
+
+    def test_multi_pipeline_multiplexing(self):
+        """Two concurrent pipelines over the same stream (the reference's
+        task parallelism across networks, SpokeLogic.scala:28-29)."""
+        cfg = JobConfig(parallelism=2, batch_size=32, test_set_size=32)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(2000, dim=4)
+        create2 = dict(CREATE, id=1, learner={"name": "SVM", "hyperParameters": {"lambda": 0.001}})
+        events = (
+            [(REQUEST_STREAM, json.dumps(CREATE)), (REQUEST_STREAM, json.dumps(create2))]
+            + [(TRAINING_STREAM, l) for l in lines]
+        )
+        report = job.run(events)
+        assert report is not None
+        assert len(report.statistics) == 2
+        pipelines = {s.pipeline for s in report.statistics}
+        assert pipelines == {0, 1}
+        for s in report.statistics:
+            assert s.score > 0.8
+
+    def test_invalid_requests_dropped(self):
+        cfg = JobConfig(parallelism=1)
+        job = StreamJob(cfg)
+        bad = [
+            '{"id": 0, "request": "Create", "learner": {"name": "Bogus"}}',
+            '{"id": 5, "request": "Delete"}',  # nonexistent
+            "not json",
+            '{"id": 0, "request": "Query"}',  # nonexistent pipeline
+        ]
+        job.run([(REQUEST_STREAM, b) for b in bad], terminate_on_end=False)
+        assert job.pipeline_manager.live_pipelines == []
+        assert job.responses == []
+
+    def test_records_before_create_are_buffered(self):
+        """Records arriving before pipeline creation are buffered and trained
+        after the Create lands (FlinkSpoke.scala:69-80)."""
+        cfg = JobConfig(parallelism=1, batch_size=32, test_set_size=16)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(500, dim=4)
+        events = (
+            [(TRAINING_STREAM, l) for l in lines[:100]]
+            + [(REQUEST_STREAM, json.dumps(CREATE))]
+            + [(TRAINING_STREAM, l) for l in lines[100:]]
+        )
+        report = job.run(events)
+        [stats] = report.statistics
+        # all 500 records participate (minus holdout + ragged tail)
+        assert stats.fitted > 300
+
+    def test_delete_stops_training(self):
+        cfg = JobConfig(parallelism=1, batch_size=16)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(200, dim=4)
+        events = (
+            [(REQUEST_STREAM, json.dumps(CREATE))]
+            + [(TRAINING_STREAM, l) for l in lines[:100]]
+            + [(REQUEST_STREAM, json.dumps({"id": 0, "request": "Delete"}))]
+            + [(TRAINING_STREAM, l) for l in lines[100:]]
+        )
+        report = job.run(events)
+        assert report is None or report.statistics == []
+
+    def test_single_learner_protocol_forced_for_kmeans(self):
+        """HT/K-means force SingleLearner: the central model trains on the
+        hub from forwarded tuples (FlinkSpoke.scala:203-210)."""
+        cfg = JobConfig(parallelism=2, batch_size=32, test_set_size=32)
+        job = StreamJob(cfg)
+        rng = np.random.RandomState(0)
+        centers = np.array([[5, 5], [-5, -5]])
+        pts = centers[rng.randint(0, 2, 1000)] + rng.randn(1000, 2) * 0.5
+        lines = [
+            json.dumps({"numericalFeatures": list(np.round(p, 4)), "target": 0.0})
+            for p in pts
+        ]
+        create = {
+            "id": 0,
+            "request": "Create",
+            "learner": {"name": "K-means", "hyperParameters": {"k": 2}},
+            "trainingConfiguration": {"protocol": "Asynchronous"},  # overridden
+        }
+        events = [(REQUEST_STREAM, json.dumps(create))] + [
+            (TRAINING_STREAM, l) for l in lines
+        ]
+        report = job.run(events)
+        [stats] = report.statistics
+        assert stats.protocol == "SingleLearner"
+        assert stats.fitted > 500
+        assert stats.models_shipped > 0  # hub shipped the model back
+
+
+class TestSilenceTimer:
+    def test_silence_triggers_termination(self):
+        cfg = JobConfig(parallelism=1, timeout_ms=1000, batch_size=16)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(100, dim=4)
+        events = [(REQUEST_STREAM, json.dumps(CREATE))] + [
+            (TRAINING_STREAM, l) for l in lines
+        ]
+        job.run(events, terminate_on_end=False)
+        assert not job.stats.terminated
+        # no activity for > timeout
+        now = job.stats.last_activity + 1.5
+        report = job.check_silence(now)
+        assert report is not None
+        assert job.stats.terminated
+
+    def test_activity_resets_timer(self):
+        cfg = JobConfig(parallelism=1, timeout_ms=1000)
+        job = StreamJob(cfg)
+        job.stats.mark_activity(100.0)
+        assert not job.stats.silence_exceeded(100.5)
+        assert job.stats.silence_exceeded(101.1)
