@@ -1,0 +1,75 @@
+"""Benchmark: HIGGS-shaped online logistic regression, examples/sec/chip.
+
+BASELINE.md config 1 ("Online logistic regression, HIGGS binary"): a
+28-feature binary-classification stream through the StandardScaler +
+logistic-regression (Softmax, K=2) pipeline — the same workload the
+reference trains per-record on the JVM (MLPipeline.pipePoint ->
+learner.fit, hs_err_pid77107.log:109-113). Here the whole pipeline step
+(scaler update + transform + LR gradient step + loss) is one jitted XLA
+program consuming fixed-shape micro-batches from host memory (streaming
+ingest modeled by feeding per-step numpy batches).
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
+computed against a 100k examples/sec proxy — a generous estimate of the
+reference's whole-job throughput at parallelism 16 on its 4C/8T workstation
+(hs_err_pid77107.log:21), i.e. vs_baseline = measured / 100_000.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec
+    from omldm_tpu.pipelines import MLPipeline
+
+    dim = 28
+    batch = 4096
+    pipe = MLPipeline(
+        LearnerSpec("Softmax", hyper_parameters={"learningRate": 0.05, "nClasses": 2}),
+        [PreprocessorSpec("StandardScaler")],
+        dim=dim,
+        rng=jax.random.PRNGKey(0),
+    )
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(dim)
+    n_stage = 32  # distinct host batches cycled to model streaming ingest
+    stage = []
+    for _ in range(n_stage):
+        x = rng.randn(batch, dim).astype(np.float32)
+        y = (x @ w > 0).astype(np.float32)
+        stage.append((x, y, np.ones(batch, np.float32)))
+
+    # warmup / compile
+    for i in range(3):
+        pipe.fit(*stage[i])
+    jax.block_until_ready(pipe.state["params"])
+
+    steps = 200
+    t0 = time.perf_counter()
+    for i in range(steps):
+        pipe.fit(*stage[i % n_stage])
+    jax.block_until_ready(pipe.state["params"])
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = steps * batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": "HIGGS-shaped online LR examples/sec/chip",
+                "value": round(examples_per_sec, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(examples_per_sec / 100_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

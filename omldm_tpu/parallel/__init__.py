@@ -1,0 +1,6 @@
+"""Mesh / sharding / collective engine: the SPMD performance path."""
+
+from omldm_tpu.parallel.mesh import make_mesh
+from omldm_tpu.parallel.spmd import SPMD_PROTOCOLS, SPMDTrainer
+
+__all__ = ["make_mesh", "SPMDTrainer", "SPMD_PROTOCOLS"]

@@ -1,0 +1,43 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's deployment topology: N Flink
+worker subtasks + h hub instances (README.md:21-29, FlinkSpoke.scala:181-195)
+become a 2-axis ``jax.sharding.Mesh``:
+
+- ``"dp"``  — data-parallel axis: one logical spoke (worker replica) per
+  mesh slot; protocol synchronization = collectives over this axis riding
+  ICI (replacing the spoke->hub->Kafka->spoke round trip, Job.scala:76-87).
+- ``"hub"`` — parameter-server shard axis (the reference's HubParallelism):
+  PS-held state is sharded over it; a protocol allreduce decomposes into
+  reduce_scatter("dp") + all_gather("hub") exactly like bucketed PS shards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: Optional[int] = None,
+    hub: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ("dp", "hub") mesh over the available devices.
+
+    With ``dp=None`` every device joins the dp axis (after dividing by hub).
+    ``dp * hub`` must not exceed the device count; on a single chip both axes
+    are 1 and the collectives compile to no-ops."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        dp = max(n // hub, 1)
+    need = dp * hub
+    if need > n:
+        raise ValueError(f"mesh ({dp}x{hub}) needs {need} devices, have {n}")
+    grid = np.asarray(devices[:need]).reshape(dp, hub)
+    return Mesh(grid, ("dp", "hub"))

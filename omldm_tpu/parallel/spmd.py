@@ -1,0 +1,409 @@
+"""SPMD protocol engine: distributed online learning as XLA collectives.
+
+This is the TPU performance path. Where the host-multiplexed runtime
+(omldm_tpu.runtime + omldm_tpu.protocols) exchanges parameter messages
+through an in-process router — semantically mirroring the reference's
+spoke -> hub -> Kafka -> spoke loop (Job.scala:76-87) — the SPMD engine
+compiles the WHOLE fleet into one program: every data-parallel worker replica
+is a mesh shard, one jitted step trains all replicas simultaneously, and
+protocol synchronization is an XLA collective over the ``"dp"`` axis riding
+ICI. The ``"hub"`` axis shards the parameter-server state: the protocol
+allreduce is decomposed into per-hub-shard ``pmean`` (reduce-scatter role) +
+``all_gather`` — the mesh-native form of the reference's bucketed
+HubParallelism PS (FlinkSpoke.scala:181-195, FlinkNetwork.scala:48-149).
+
+Protocol mapping (SURVEY.md section 7 step 5):
+
+- ``Synchronous``   — every ``syncEvery`` batches: params <- psmean over dp.
+- ``EASGD``         — elastic interaction with a center variable kept in
+                      state: x_i -= a(x_i - c); c += a*mean(x_i - c).
+- ``GM``            — local drift check; a 1-scalar psum votes on violation;
+                      the expensive parameter collective runs under
+                      ``lax.cond`` only when some worker left the sphere —
+                      communication skipping preserved on real hardware.
+- ``FGM``           — safe-zone sum psi = psum(phi_i) decides; same
+                      conditional collective. (The increment-counting phase
+                      exists to avoid coordinator chatter on a network; on an
+                      ICI mesh the 1-scalar psum IS cheaper than any counter
+                      machinery, so the safe-zone semantics are kept and the
+                      counters retired — see the host-plane FGM for the
+                      faithful two-phase variant.)
+- ``Asynchronous``  — staggered sync: worker w folds its delta into the
+                      shared global every ``syncEvery`` steps at offset
+                      w mod syncEvery, emulating uncoordinated PS pushes in
+                      lockstep SPMD.
+- ``SSP``           — same staggered schedule; the staleness bound is
+                      trivially satisfied in lockstep (the host plane
+                      implements true bounded staleness).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec, TrainingConfiguration
+from omldm_tpu.learners.registry import make_learner
+from omldm_tpu.preprocessors.registry import make_preprocessor
+from omldm_tpu.parallel.mesh import make_mesh
+
+
+def _pvary(x, axes):
+    """Invariant -> varying cast (pvary was deprecated in favor of pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
+
+SPMD_PROTOCOLS = (
+    "Synchronous",
+    "EASGD",
+    "GM",
+    "FGM",
+    "Asynchronous",
+    "SSP",
+)
+
+
+def _sq(leaf):
+    """Strip the [1, 1] (dp, hub) leading stacking dims of a per-shard leaf."""
+    return leaf[0, 0]
+
+
+def _unsq(leaf):
+    return leaf[None, None]
+
+
+class SPMDTrainer:
+    """One pipeline trained data-parallel across a ("dp", "hub") mesh.
+
+    State leaves are stacked ``[dp, hub, ...]`` and sharded one slot per mesh
+    shard; micro-batches arrive stacked ``[dp, B, D]`` (one batch per
+    worker). ``step`` runs one jitted, donated training step for the whole
+    fleet."""
+
+    def __init__(
+        self,
+        learner_spec: LearnerSpec,
+        preprocessor_specs: Sequence[PreprocessorSpec] = (),
+        dim: int = 0,
+        protocol: str = "Synchronous",
+        mesh=None,
+        training_configuration: Optional[TrainingConfiguration] = None,
+        batch_size: int = 256,
+        seed: int = 0,
+    ):
+        if protocol not in SPMD_PROTOCOLS:
+            raise ValueError(
+                f"SPMD engine supports {SPMD_PROTOCOLS}, got {protocol!r}; "
+                "host-side models (HT) and SingleLearner/CentralizedTraining "
+                "run in the host-multiplexed runtime"
+            )
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.dp = self.mesh.shape["dp"]
+        self.hub = self.mesh.shape["hub"]
+        self.protocol = protocol
+        self.tc = training_configuration or TrainingConfiguration(protocol=protocol)
+        self.learner = make_learner(learner_spec)
+        if self.learner.host_side:
+            raise ValueError("host-side learners cannot run in the SPMD engine")
+        self.preps = [make_preprocessor(p) for p in preprocessor_specs]
+        self.dim = dim
+        self.batch_size = batch_size
+        self.sync_every = int(self.tc.extra.get("syncEvery", 4))
+        self.threshold = float(self.tc.extra.get("threshold", 0.5))
+        default_alpha = 0.5 / max(self.dp, 1)
+        self.alpha = float(self.tc.extra.get("alpha", default_alpha))
+
+        # feature dims through the prep chain
+        d = dim
+        prep_dims = [d]
+        for p in self.preps:
+            d = p.out_dim(d)
+            prep_dims.append(d)
+        self.learner_dim = d
+
+        # template params -> flat layout shared by every replica
+        template = self.learner.init(d, jax.random.PRNGKey(seed))
+        flat0, self._unravel = jax.flatten_util.ravel_pytree(template)
+        self.n_params = int(flat0.size)
+        self.pad = (-self.n_params) % self.hub
+        self.flat_size = self.n_params + self.pad
+        self.shard_size = self.flat_size // self.hub
+
+        state_host = self._init_state(seed, prep_dims, template)
+        spec = NamedSharding(self.mesh, P("dp", "hub"))
+        self.state = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(jnp.asarray(leaf), spec), state_host
+        )
+        self._state_specs = jax.tree_util.tree_map(
+            lambda _: P("dp", "hub"), state_host
+        )
+
+        step_impl = self._build_step()
+        batch_spec = P("dp")
+        self._step = jax.jit(
+            jax.shard_map(
+                step_impl,
+                mesh=self.mesh,
+                in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
+                out_specs=(self._state_specs, P("dp", "hub")),
+            ),
+            donate_argnums=0,
+        )
+        self._fitted_host = 0
+        self._steps_host = 0
+        self._curve: List[Tuple[Any, int]] = []
+
+    # --- state construction ---
+
+    def _init_state(self, seed: int, prep_dims, template):
+        keys = jax.random.split(jax.random.PRNGKey(seed), self.dp)
+        params_dp = jax.vmap(lambda k: self.learner.init(self.learner_dim, k))(keys)
+
+        def stack(leaf):  # [dp, ...] -> [dp, hub, ...]
+            return np.repeat(np.asarray(leaf)[:, None], self.hub, axis=1)
+
+        params = jax.tree_util.tree_map(stack, params_dp)
+        preps = [
+            jax.tree_util.tree_map(
+                lambda l: stack(np.broadcast_to(np.asarray(l), (self.dp,) + np.shape(l))),
+                p.init(di),
+            )
+            for p, di in zip(self.preps, prep_dims)
+        ]
+        flat_template, _ = jax.flatten_util.ravel_pytree(template)
+        flat_padded = np.concatenate(
+            [np.asarray(flat_template), np.zeros((self.pad,), np.float32)]
+        )
+        vec = stack(np.broadcast_to(flat_padded, (self.dp, self.flat_size)))
+        zero = stack(np.zeros((self.dp,), np.float32))
+        izero = stack(np.zeros((self.dp,), np.int32))
+        return {
+            "params": params,
+            "preps": preps,
+            "est": vec.copy(),     # estimate at last sync (GM/FGM/async base)
+            "center": vec.copy(),  # EASGD center / async-SSP global
+            "step": izero.copy(),
+            "syncs": izero.copy(),
+            "cum_loss": zero.copy(),
+        }
+
+    # --- the per-shard step ---
+
+    def _flat(self, params):
+        flat, _ = jax.flatten_util.ravel_pytree(params)
+        if self.pad:
+            flat = jnp.concatenate([flat, jnp.zeros((self.pad,), flat.dtype)])
+        return flat
+
+    def _unflat(self, flat):
+        return self._unravel(flat[: self.n_params])
+
+    def _ps_allreduce(self, flat):
+        """pmean over workers, decomposed through the hub-sharded PS:
+        each hub shard reduces its param bucket (reduce-scatter role), then
+        the buckets are re-assembled with an all_gather."""
+        i = jax.lax.axis_index("hub")
+        my = jax.lax.dynamic_slice(flat, (i * self.shard_size,), (self.shard_size,))
+        avg = jax.lax.pmean(my, "dp")
+        full = jax.lax.all_gather(avg, "hub", tiled=True)
+        return _pvary(full, "dp")
+
+    def _build_step(self):
+        learner = self.learner
+        preps = self.preps
+        per_record = self.tc.per_record
+        protocol = self.protocol
+        sync_every = max(self.sync_every, 1)
+        threshold = self.threshold
+        alpha = self.alpha
+        n_workers = self.dp
+
+        def step_fn(state, x, y, mask):
+            # per-shard views: state leaves [1,1,...]; batch [1,B,D]
+            x = _pvary(x[0], "hub")
+            y = _pvary(y[0], "hub")
+            mask = _pvary(mask[0], "hub")
+            params = jax.tree_util.tree_map(_sq, state["params"])
+            prep_states = [jax.tree_util.tree_map(_sq, s) for s in state["preps"]]
+            est = _sq(state["est"])
+            center = _sq(state["center"])
+            step_i = _sq(state["step"])
+            syncs = _sq(state["syncs"])
+            cum_loss = _sq(state["cum_loss"])
+
+            # preprocessors: online stats update + transform
+            new_preps = []
+            z = x
+            for prep, s in zip(preps, prep_states):
+                s = prep.update(s, z, mask)
+                new_preps.append(s)
+                z = prep.transform(s, z)
+
+            update = learner.update_per_record if per_record else learner.update
+            params, loss = update(params, z, y, mask)
+
+            flat = self._flat(params)
+            step_i = step_i + 1
+            at_cadence = (step_i % sync_every) == 0
+
+            if protocol == "Synchronous":
+                def do_sync(f, e, c, s):
+                    g = self._ps_allreduce(f)
+                    return g, g, c, s + 1
+
+                flat, est, center, syncs = jax.lax.cond(
+                    at_cadence, do_sync,
+                    lambda f, e, c, s: (f, e, c, s),
+                    flat, est, center, syncs,
+                )
+            elif protocol == "EASGD":
+                def do_sync(f, e, c, s):
+                    mean_x = self._ps_allreduce(f)
+                    new_c = c + alpha * n_workers * (mean_x - c)
+                    new_f = f - alpha * (f - c)
+                    return new_f, e, new_c, s + 1
+
+                flat, est, center, syncs = jax.lax.cond(
+                    at_cadence, do_sync,
+                    lambda f, e, c, s: (f, e, c, s),
+                    flat, est, center, syncs,
+                )
+            elif protocol in ("GM", "FGM"):
+                drift2 = jnp.sum((flat - est) ** 2)
+                if protocol == "GM":
+                    # any worker outside the sphere => global violation
+                    violations = jax.lax.psum(
+                        (drift2 > threshold**2).astype(jnp.float32), "dp"
+                    )
+                    fire = violations > 0
+                else:
+                    # FGM safe zone: psi = sum_i (drift_i^2 - T^2) >= 0
+                    psi = jax.lax.psum(drift2 - threshold**2, "dp")
+                    fire = psi >= 0.0
+
+                def do_sync(f, e, c, s):
+                    g = self._ps_allreduce(f)
+                    return g, g, c, s + 1
+
+                flat, est, center, syncs = jax.lax.cond(
+                    jnp.logical_and(at_cadence, fire), do_sync,
+                    lambda f, e, c, s: (f, e, c, s),
+                    flat, est, center, syncs,
+                )
+            else:  # Asynchronous / SSP: staggered folds into the shared global
+                w = jax.lax.axis_index("dp")
+                my_turn = jnp.logical_and(
+                    (step_i % sync_every) == (w % sync_every), step_i >= 1
+                )
+                contrib = jnp.where(my_turn, flat - est, jnp.zeros_like(flat))
+                # shared global accumulates deltas scaled by 1/n (PS fold);
+                # routed through the hub shards like every other collective
+                i = jax.lax.axis_index("hub")
+                my = jax.lax.dynamic_slice(
+                    contrib, (i * self.shard_size,), (self.shard_size,)
+                )
+                folded = jax.lax.psum(my, "dp") / float(n_workers)
+                full_delta = _pvary(
+                    jax.lax.all_gather(folded, "hub", tiled=True), "dp"
+                )
+                center = center + full_delta
+                flat = jnp.where(my_turn, center, flat)
+                est = jnp.where(my_turn, center, est)
+                syncs = syncs + my_turn.astype(jnp.int32)
+
+            params = self._unflat(flat)
+            n = jnp.sum(mask)
+            cum_loss = cum_loss + loss * n
+
+            new_state = {
+                "params": jax.tree_util.tree_map(_unsq, params),
+                "preps": [
+                    jax.tree_util.tree_map(_unsq, s) for s in new_preps
+                ],
+                "est": _unsq(est),
+                "center": _unsq(center),
+                "step": _unsq(step_i),
+                "syncs": _unsq(syncs),
+                "cum_loss": _unsq(cum_loss),
+            }
+            return new_state, _unsq(loss)
+
+        return step_fn
+
+    # --- public API ---
+
+    def step(self, x, y, mask):
+        """One fleet step. x: [dp, B, D]; y, mask: [dp, B] (host arrays).
+        Returns the lazy [dp, hub] loss array."""
+        n = int(np.asarray(mask).sum())
+        self.state, loss = self._step(self.state, x, y, mask)
+        self._fitted_host += n
+        self._steps_host += 1
+        self._curve.append((loss, self._fitted_host))
+        return loss
+
+    @property
+    def fitted(self) -> int:
+        return self._fitted_host
+
+    def curve_slice(self) -> List[Tuple[float, int]]:
+        fresh = self._curve
+        self._curve = []
+        return [(float(np.asarray(l).mean()), f) for l, f in fresh]
+
+    def sync_count(self) -> int:
+        """Total parameter synchronizations executed (summed over workers for
+        staggered protocols; rounds for the others)."""
+        syncs = np.asarray(jax.device_get(self.state["syncs"]))
+        if self.protocol in ("Asynchronous", "SSP"):
+            return int(syncs[:, 0].sum())
+        return int(syncs[0, 0])
+
+    def bytes_shipped(self) -> int:
+        """Collective-bytes accounting reproducing the reference's
+        bytesShipped semantics (FlinkHub.scala:118-127): one sync moves every
+        worker's params up and the global back down."""
+        per_sync = 2 * self.flat_size * 4
+        mult = 1 if self.protocol in ("Asynchronous", "SSP") else self.dp
+        return self.sync_count() * per_sync * mult
+
+    def global_flat_params(self) -> np.ndarray:
+        """Model of worker 0 / hub 0 (post-sync replicas agree)."""
+        flat, _ = jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(lambda l: jax.device_get(l)[0, 0], self.state["params"])
+        )
+        return np.asarray(flat)
+
+    def shard_params(self):
+        """Per-worker params pytree list (host copies)."""
+        out = []
+        for w in range(self.dp):
+            out.append(
+                jax.tree_util.tree_map(
+                    lambda l: jax.device_get(l)[w, 0], self.state["params"]
+                )
+            )
+        return out
+
+    def evaluate(self, x, y, mask) -> Tuple[float, float]:
+        """Loss/score of the worker-0 model on a host-side holdout set."""
+        params = jax.tree_util.tree_map(
+            lambda l: jax.device_get(l)[0, 0], self.state["params"]
+        )
+        prep_states = [
+            jax.tree_util.tree_map(lambda l: jax.device_get(l)[0, 0], s)
+            for s in self.state["preps"]
+        ]
+        z = jnp.asarray(x)
+        for prep, s in zip(self.preps, prep_states):
+            z = prep.transform(s, z)
+        loss = self.learner.loss(params, z, jnp.asarray(y), jnp.asarray(mask))
+        score = self.learner.score(params, z, jnp.asarray(y), jnp.asarray(mask))
+        return float(loss), float(score)

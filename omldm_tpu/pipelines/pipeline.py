@@ -159,7 +159,8 @@ class MLPipeline:
         """Flatten learner params to one vector (for bucketed query responses
         and protocol messaging); returns (flat, unravel_fn)."""
         flat, unravel = jax.flatten_util.ravel_pytree(self.state["params"])
-        return np.asarray(flat), unravel
+        # writable copy: protocol code mutates shards in place
+        return np.array(flat), unravel
 
     def set_flat_params(self, flat: np.ndarray) -> None:
         _, unravel = jax.flatten_util.ravel_pytree(self.state["params"])

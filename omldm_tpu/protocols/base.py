@@ -66,8 +66,8 @@ class WorkerNode:
         """Serve predictions with the local (possibly stale) model."""
         return np.asarray(self.pipeline.predict(x))
 
-    def receive(self, op: str, payload: Any) -> None:
-        """Handle a hub->worker message."""
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        """Handle a hub->worker message from hub shard ``hub_id``."""
 
     def query_stats(self) -> dict:
         """Fitted/loss numbers for query responses. Protocols whose model
@@ -115,9 +115,18 @@ class HubNode:
     def count_received(self, payload: Any) -> None:
         self.stats.update_stats(bytes_shipped=payload_size(payload))
 
-    def count_shipped(self, payload: Any, n_dest: int = 1, blocks: int = 1) -> None:
+    def count_shipped(
+        self,
+        payload: Any,
+        n_dest: int = 1,
+        blocks: int = 1,
+        models: Optional[int] = None,
+    ) -> None:
+        """``models`` overrides the model count (shard hubs > 0 pass 0 so a
+        model sharded over h hubs counts once, with h blocks — matching the
+        reference's modelsShipped vs numOfBlocks split, FlinkHub.scala:118-127)."""
         self.stats.update_stats(
-            models_shipped=n_dest,
+            models_shipped=n_dest if models is None else models,
             bytes_shipped=payload_size(payload) * n_dest,
             num_of_blocks=blocks,
         )

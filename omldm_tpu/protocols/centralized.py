@@ -89,7 +89,7 @@ class ForwardingWorker(WorkerNode):
         self.send(OP_PUSH, {"x": x, "y": y, "mask": mask}, 0)
         return None
 
-    def receive(self, op: str, payload: Any) -> None:
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
         if op == OP_UPDATE:
             # model is the central pipeline state (in-process shared for
             # host-side models like HT; flat vector otherwise)

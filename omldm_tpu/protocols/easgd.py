@@ -1,0 +1,74 @@
+"""Elastic Averaging SGD (EASGD).
+
+Reference counterpart: ``EASGDWorker`` / ``EASGDParameterServer``
+(MLNodeGenerator.scala table row "EASGD"). Zhang, Choromanska & LeCun 2015:
+each worker keeps exploring with its local params x_i; a center variable
+x_tilde lives on the PS; on each elastic interaction
+
+    x_i     <- x_i     - alpha * (x_i - x_tilde)
+    x_tilde <- x_tilde + alpha * (x_i - x_tilde)
+
+(the asynchronous EASGD variant: interactions happen per worker push, not in
+global rounds). ``alpha`` comes from the config extras (default 0.5/n, the
+paper's stable choice for moving-rate beta=0.9 with n workers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from omldm_tpu.protocols.base import HubNode
+from omldm_tpu.protocols.common import SyncingWorker, shard_slice
+from omldm_tpu.runtime.messages import OP_PUSH, OP_UPDATE
+
+
+class EASGDWorker(SyncingWorker):
+    def on_sync_point(self) -> None:
+        self.send_vector(OP_PUSH, "params", self.get_flat())
+
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        if op == OP_UPDATE:
+            # payload is the elastic difference alpha*(x_i - x_tilde) for this
+            # hub's shard, to subtract from the local params
+            current = self.get_flat()
+            if self.n_hubs == 1:
+                self.set_flat(current - payload)
+            else:
+                sl = shard_slice(hub_id, current.size, self.n_hubs)
+                current[sl] = current[sl] - payload
+                self.set_flat(current)
+
+    def final_push(self) -> None:
+        self.on_sync_point()
+
+
+class EASGDParameterServer(HubNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        default_alpha = 0.5 / max(self.n_workers, 1)
+        self.alpha = float(self.config.extra.get("alpha", default_alpha))
+        self.center: Optional[np.ndarray] = None
+        self._fitted_seen: Dict[int, int] = {}
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op != OP_PUSH:
+            return
+        self.count_received(payload)
+        self.record_curve(payload["curve"])
+        d = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+        self._fitted_seen[worker_id] = payload["fitted"]
+        self.stats.update_fitted(max(d, 0))
+
+        x_i = payload["params"]
+        if self.center is None:
+            self.center = x_i.copy()
+        elastic = self.alpha * (x_i - self.center)
+        self.center = self.center + elastic
+        self.count_shipped(elastic, models=1 if self.hub_id == 0 else 0)
+        self.reply(worker_id, OP_UPDATE, elastic)
+
+    @property
+    def global_params(self) -> Optional[np.ndarray]:
+        return self.center

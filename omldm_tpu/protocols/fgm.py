@@ -1,0 +1,152 @@
+"""Functional Geometric Monitoring (FGM) — two-phase safe-zone protocol.
+
+Reference counterpart: ``FGMWorker`` / ``FGMParameterServer``
+(MLNodeGenerator.scala table row "FGM"). Samoladas & Garofalakis's
+functional variant of geometric monitoring, the OMLDM research payload:
+instead of per-worker violations, the coordinator monitors the *sum* of a
+convex safe function
+
+    phi_i = ||w_i - e||^2 - T^2        (safe while  psi = sum_i phi_i < 0)
+
+in two phases:
+
+1. **increment counting** — each round/subround has a quantum
+   ``theta = -psi_0 / (2n)``; workers send tiny integer counter increments
+   ``c_i = floor((phi_i - phi_i^0) / theta)`` as they drift; the coordinator
+   only acts when the summed counter crosses ``n``;
+2. **subround poll** — the coordinator polls exact ``phi_i`` values; if
+   ``psi`` is still safe it starts a new subround with a smaller quantum,
+   otherwise it collects all models, averages, and begins a new round with a
+   fresh estimate.
+
+Config extras: ``threshold`` (safe radius T, default 0.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from omldm_tpu.protocols.base import HubNode
+from omldm_tpu.protocols.common import SyncingWorker
+from omldm_tpu.runtime.messages import OP_PULL, OP_PUSH, OP_UPDATE, OP_ZETA
+
+
+class FGMWorker(SyncingWorker):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.threshold = float(self.config.extra.get("threshold", 0.5))
+        self._estimate: Optional[np.ndarray] = None
+        self._theta: float = self.threshold**2 / 2.0
+        self._phi0: float = -(self.threshold**2)
+        self._counter = 0
+
+    def on_start(self) -> None:
+        self._estimate = self.get_flat()
+
+    def _phi(self) -> float:
+        current = self.get_flat()
+        est = self._estimate if self._estimate is not None else np.zeros_like(current)
+        return float(np.sum((current - est) ** 2) - self.threshold**2)
+
+    def on_sync_point(self) -> None:
+        if self._theta <= 0:
+            return
+        c_new = int(np.floor((self._phi() - self._phi0) / self._theta))
+        if c_new > self._counter:
+            inc = c_new - self._counter
+            self._counter = c_new
+            self.send(OP_ZETA, {"inc": inc, **self.piggyback()}, 0)
+
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        if op == OP_ZETA and payload.get("poll"):
+            self.send(OP_ZETA, {"phi": self._phi()}, 0)
+        elif op == OP_PULL:
+            self.send(OP_PUSH, {"params": self.get_flat(), **self.piggyback()}, 0)
+        elif op == OP_UPDATE:
+            if payload.get("params") is not None:
+                self.set_flat(payload["params"])
+                self._estimate = payload["params"]
+                self._phi0 = -(self.threshold**2)
+            else:
+                # new subround: tighter quantum, counters reset from the
+                # polled phi baseline
+                self._phi0 = self._phi()
+            self._theta = payload["theta"]
+            self._counter = 0
+
+    def final_push(self) -> None:
+        self.send(OP_PUSH, {"params": self.get_flat(), **self.piggyback()}, 0)
+
+
+class FGMParameterServer(HubNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.threshold = float(self.config.extra.get("threshold", 0.5))
+        self._global_counter = 0
+        self._polling = False
+        self._phis: Dict[int, float] = {}
+        self._collecting = False
+        self._collected: Dict[int, np.ndarray] = {}
+        self._fitted_seen: Dict[int, int] = {}
+        self.global_params: Optional[np.ndarray] = None
+        self.rounds = 0
+        self.subrounds = 0
+
+    def _account(self, worker_id: int, payload: Any) -> None:
+        self.count_received(payload)
+        if "curve" in payload:
+            self.record_curve(payload["curve"])
+        if "fitted" in payload:
+            d = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+            self._fitted_seen[worker_id] = payload["fitted"]
+            self.stats.update_fitted(max(d, 0))
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op == OP_ZETA and "inc" in payload:
+            self._account(worker_id, payload)
+            self._global_counter += payload["inc"]
+            if self._global_counter > self.n_workers and not (
+                self._polling or self._collecting
+            ):
+                self._polling = True
+                self._phis.clear()
+                self.count_shipped({"poll": True}, n_dest=self.n_workers)
+                self.broadcast(OP_ZETA, {"poll": True})
+        elif op == OP_ZETA and "phi" in payload:
+            self.count_received(payload)
+            self._phis[worker_id] = payload["phi"]
+            if self._polling and len(self._phis) >= self.n_workers:
+                self._polling = False
+                psi = sum(self._phis.values())
+                if psi >= 0:
+                    # safe zone breached: full synchronization round
+                    self._collecting = True
+                    self._collected.clear()
+                    self.count_shipped({"pull": True}, n_dest=self.n_workers)
+                    self.broadcast(OP_PULL, {})
+                else:
+                    # still safe: new subround with a tighter quantum
+                    self.subrounds += 1
+                    self._global_counter = 0
+                    theta = -psi / (2.0 * self.n_workers)
+                    self.count_shipped({"theta": theta}, n_dest=self.n_workers)
+                    self.broadcast(OP_UPDATE, {"params": None, "theta": theta})
+        elif op == OP_PUSH:
+            self._account(worker_id, payload)
+            self._collected[worker_id] = payload["params"]
+            if len(self._collected) >= self.n_workers:
+                self._finish_round()
+
+    def _finish_round(self) -> None:
+        stacked = np.stack(list(self._collected.values()))
+        self.global_params = stacked.mean(axis=0)
+        self._collected.clear()
+        self._collecting = False
+        self._global_counter = 0
+        self.rounds += 1
+        theta = self.threshold**2 / 2.0
+        payload = {"params": self.global_params, "theta": theta}
+        self.count_shipped(payload, n_dest=self.n_workers)
+        self.broadcast(OP_UPDATE, payload)

@@ -1,0 +1,101 @@
+"""Geometric Monitoring (GM) — threshold-based communication skipping.
+
+Reference counterpart: ``GMWorker`` / ``GMParameterServer``
+(MLNodeGenerator.scala table row "GM"). Distributed geometric monitoring of
+model drift (Sharfman et al. / the OMLDM author's research line): the PS
+holds an estimate ``e`` (the model average at the last synchronization);
+each worker monitors its local drift ``||w_i - e||``; while every worker
+stays inside the threshold sphere no parameters move at all — workers ship
+only when a *local violation* occurs, at which point the PS collects all
+models, averages, and starts a new round with the new estimate.
+
+Config extras: ``threshold`` (drift radius T, default 0.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from omldm_tpu.protocols.base import HubNode
+from omldm_tpu.protocols.common import SyncingWorker
+from omldm_tpu.runtime.messages import OP_PULL, OP_PUSH, OP_UPDATE, OP_ZETA
+
+
+class GMWorker(SyncingWorker):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.threshold = float(self.config.extra.get("threshold", 0.5))
+        self._estimate: Optional[np.ndarray] = None
+        self._violated = False
+
+    def on_start(self) -> None:
+        self._estimate = self.get_flat()
+
+    def on_sync_point(self) -> None:
+        if self._violated:
+            return  # already reported this round; wait for collection
+        current = self.get_flat()
+        est = self._estimate if self._estimate is not None else np.zeros_like(current)
+        drift = float(np.linalg.norm(current - est))
+        if drift > self.threshold:
+            self._violated = True
+            # tiny violation message — the protocol's whole point is that
+            # this is NOT a model transfer
+            self.send(OP_ZETA, {"violation": True, **self.piggyback()}, 0)
+
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        if op == OP_PULL:
+            # PS collects models after a violation
+            self.send(OP_PUSH, {"params": self.get_flat(), **self.piggyback()}, 0)
+        elif op == OP_UPDATE:
+            self.set_flat(payload)
+            self._estimate = payload
+            self._violated = False
+
+    def final_push(self) -> None:
+        self.send(OP_PUSH, {"params": self.get_flat(), **self.piggyback()}, 0)
+
+
+class GMParameterServer(HubNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._collecting = False
+        self._collected: Dict[int, np.ndarray] = {}
+        self._fitted_seen: Dict[int, int] = {}
+        self.global_params: Optional[np.ndarray] = None
+        self.rounds = 0
+
+    def _account(self, worker_id: int, payload: Any) -> None:
+        self.count_received(payload)
+        if "curve" in payload:
+            self.record_curve(payload["curve"])
+        if "fitted" in payload:
+            d = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+            self._fitted_seen[worker_id] = payload["fitted"]
+            self.stats.update_fitted(max(d, 0))
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op == OP_ZETA and payload.get("violation"):
+            self._account(worker_id, payload)
+            if not self._collecting:
+                self._collecting = True
+                self._collected.clear()
+                self.count_shipped({"pull": True}, n_dest=self.n_workers)
+                self.broadcast(OP_PULL, {})
+        elif op == OP_PUSH:
+            # collection rounds and quiesce-time final pushes fold identically
+            self._account(worker_id, payload)
+            self._collected[worker_id] = payload["params"]
+            if len(self._collected) >= self.n_workers:
+                self._finish_round()
+
+    def _finish_round(self) -> None:
+        stacked = np.stack(list(self._collected.values()))
+        self.global_params = stacked.mean(axis=0)
+        self._collected.clear()
+        self._collecting = False
+        self.rounds += 1
+        self.count_shipped(self.global_params, n_dest=self.n_workers)
+        self.broadcast(OP_UPDATE, self.global_params)

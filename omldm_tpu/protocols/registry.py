@@ -16,16 +16,35 @@ from typing import Dict, Tuple, Type
 from omldm_tpu.api.requests import TrainingConfiguration
 from omldm_tpu.learners.registry import SINGLE_LEARNER_ONLY
 from omldm_tpu.protocols.base import HubNode, WorkerNode
+from omldm_tpu.protocols.async_ps import (
+    AsynchronousParameterServer,
+    AsynchronousWorker,
+)
 from omldm_tpu.protocols.centralized import (
     CentralizedMLServer,
     ForwardingWorker,
     SimplePS,
     SingleWorker,
 )
+from omldm_tpu.protocols.easgd import EASGDParameterServer, EASGDWorker
+from omldm_tpu.protocols.fgm import FGMParameterServer, FGMWorker
+from omldm_tpu.protocols.gm import GMParameterServer, GMWorker
+from omldm_tpu.protocols.sync import (
+    SSPParameterServer,
+    SSPWorker,
+    SynchronousParameterServer,
+    SynchronousWorker,
+)
 
 PROTOCOLS: Dict[str, Tuple[Type[WorkerNode], Type[HubNode]]] = {
     "CentralizedTraining": (SingleWorker, SimplePS),
     "SingleLearner": (ForwardingWorker, CentralizedMLServer),
+    "Asynchronous": (AsynchronousWorker, AsynchronousParameterServer),
+    "Synchronous": (SynchronousWorker, SynchronousParameterServer),
+    "SSP": (SSPWorker, SSPParameterServer),
+    "EASGD": (EASGDWorker, EASGDParameterServer),
+    "GM": (GMWorker, GMParameterServer),
+    "FGM": (FGMWorker, FGMParameterServer),
 }
 
 

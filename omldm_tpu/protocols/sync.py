@@ -1,0 +1,185 @@
+"""Synchronous (BSP) and stale-synchronous (SSP) parameter servers.
+
+Reference counterparts: ``SynchronousWorker``/``SynchronousParameterServer``
+(bulk-synchronous rounds) and ``SSPWorker``/``SSPParameterServer`` (bounded
+staleness) — MLNodeGenerator.scala:20-76.
+
+- Synchronous: a worker that reaches its sync point blocks (buffers incoming
+  batches) until the PS has collected contributions from ALL workers,
+  averaged them, and broadcast the round's global model.
+- SSP: workers advance in local rounds; a worker may run ahead of the
+  slowest worker by at most ``staleness`` rounds (config extra, default 3).
+  Within the bound it keeps training with its (stale) local view; beyond it,
+  it blocks until the stragglers catch up. The PS folds each pushed model
+  into a running global and releases blocked workers as the slowest clock
+  advances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from omldm_tpu.protocols.base import HubNode
+from omldm_tpu.protocols.common import SyncingWorker
+from omldm_tpu.runtime.messages import OP_PUSH, OP_UPDATE
+
+
+class SynchronousWorker(SyncingWorker):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pending_hubs: set = set()
+
+    def on_sync_point(self) -> None:
+        # mark waiting BEFORE pushing: with in-process routing the hub's
+        # round-completing broadcast arrives synchronously inside send_vector,
+        # and setting the flags afterwards would overwrite the already-received
+        # release and stall the whole fleet
+        self._pending_hubs = set(range(self.n_hubs))
+        self.waiting = True  # block until every hub shard replies
+        self.send_vector(OP_PUSH, "params", self.get_flat())
+
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        if op == OP_UPDATE:
+            self.apply_shard(payload, hub_id)
+            self._pending_hubs.discard(hub_id)
+            if not self._pending_hubs:
+                self.waiting = False
+                self.drain_blocked()
+
+    def final_push(self) -> None:
+        self.send_vector(OP_PUSH, "params", self.get_flat())
+
+
+class SynchronousParameterServer(HubNode):
+    """Collects one contribution per worker per round; averages; broadcasts."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._round: Dict[int, np.ndarray] = {}
+        self._fitted_seen: Dict[int, int] = {}
+        self.global_params: Optional[np.ndarray] = None
+
+    def _account(self, worker_id: int, payload: Any) -> None:
+        self.count_received(payload)
+        self.record_curve(payload["curve"])
+        d = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+        self._fitted_seen[worker_id] = payload["fitted"]
+        self.stats.update_fitted(max(d, 0))
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op != OP_PUSH:
+            return
+        self._account(worker_id, payload)
+        self._round[worker_id] = payload["params"]
+        if len(self._round) >= self.n_workers:
+            stacked = np.stack(list(self._round.values()))
+            self.global_params = stacked.mean(axis=0)
+            self._round.clear()
+            self.count_shipped(
+                self.global_params,
+                n_dest=self.n_workers,
+                models=self.n_workers if self.hub_id == 0 else 0,
+            )
+            self.broadcast(OP_UPDATE, self.global_params)
+
+    def on_terminate(self) -> None:
+        # release any round stuck behind a straggler that quiesced
+        if self._round and self.global_params is None:
+            stacked = np.stack(list(self._round.values()))
+            self.global_params = stacked.mean(axis=0)
+
+
+class SSPWorker(SyncingWorker):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.clock = 0
+        self._wait_hubs: set = set()
+
+    def on_sync_point(self) -> None:
+        self.clock += 1
+        self.send_vector(
+            OP_PUSH, "params", self.get_flat(), extra={"clock": self.clock}
+        )
+        # optimistically continue; the PS replies OP_UPDATE with either the
+        # fresher global (non-blocking) or a "wait" order when over-fresh
+
+    def receive(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        if op == OP_UPDATE:
+            if payload.get("params") is not None:
+                self.apply_shard(payload["params"], hub_id)
+            if payload.get("wait", False):
+                self._wait_hubs.add(hub_id)
+            else:
+                self._wait_hubs.discard(hub_id)
+            self.waiting = bool(self._wait_hubs)
+            if not self.waiting:
+                self.drain_blocked()
+
+    def final_push(self) -> None:
+        self.send_vector(
+            OP_PUSH, "params", self.get_flat(), extra={"clock": self.clock}
+        )
+
+
+class SSPParameterServer(HubNode):
+    """Tracks per-worker clocks; enforces ``fastest - slowest <= staleness``."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.staleness = int(self.config.extra.get("staleness", 3))
+        self._clocks: Dict[int, int] = {}
+        self._fitted_seen: Dict[int, int] = {}
+        self._waiting: Dict[int, bool] = {}
+        self.global_params: Optional[np.ndarray] = None
+
+    def _slowest(self) -> int:
+        if len(self._clocks) < self.n_workers:
+            return 0  # workers that never pushed are at clock 0
+        return min(self._clocks.values())
+
+    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        if op != OP_PUSH:
+            return
+        self.count_received(payload)
+        self.record_curve(payload["curve"])
+        d = payload["fitted"] - self._fitted_seen.get(worker_id, 0)
+        self._fitted_seen[worker_id] = payload["fitted"]
+        self.stats.update_fitted(max(d, 0))
+
+        self._clocks[worker_id] = payload["clock"]
+        if self.global_params is None:
+            self.global_params = payload["params"].copy()
+        else:
+            # running average fold (async-style within the staleness window)
+            self.global_params = (
+                self.global_params * (self.n_workers - 1) + payload["params"]
+            ) / float(self.n_workers)
+
+        ahead = payload["clock"] - self._slowest()
+        wait = ahead > self.staleness
+        self._waiting[worker_id] = wait
+        self.count_shipped(
+            self.global_params, models=1 if self.hub_id == 0 else 0
+        )
+        self.reply(worker_id, OP_UPDATE, {"params": self.global_params, "wait": wait})
+        if not wait:
+            self._release_unblocked()
+
+    def _release_unblocked(self) -> None:
+        slowest = self._slowest()
+        for w, waiting in list(self._waiting.items()):
+            if waiting and self._clocks.get(w, 0) - slowest <= self.staleness:
+                self._waiting[w] = False
+                self.count_shipped(
+                    self.global_params, models=1 if self.hub_id == 0 else 0
+                )
+                self.reply(w, OP_UPDATE, {"params": self.global_params, "wait": False})
+
+    def on_terminate(self) -> None:
+        # release everything at quiesce
+        for w in list(self._waiting):
+            if self._waiting[w]:
+                self._waiting[w] = False
+                self.reply(w, OP_UPDATE, {"params": self.global_params, "wait": False})

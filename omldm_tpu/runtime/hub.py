@@ -85,7 +85,8 @@ class HubManager:
     def __init__(self, config: JobConfig, reply_to_spoke: Callable):
         self.config = config
         self.hubs: Dict[Tuple[int, int], Hub] = {}
-        self._reply_to_spoke = reply_to_spoke  # (network_id, worker_id, op, payload)
+        # (network_id, hub_id, worker_id, op, payload)
+        self._reply_to_spoke = reply_to_spoke
         self._pre_creation: Dict[Tuple[int, int], DataSet] = {}
 
     def create_hub(self, request: Request, hub_id: int, dim: int) -> Hub:
@@ -95,11 +96,11 @@ class HubManager:
         net_id = request.id
 
         def reply(worker_id: int, op: str, payload: Any) -> None:
-            self._reply_to_spoke(net_id, worker_id, op, payload)
+            self._reply_to_spoke(net_id, hub_id, worker_id, op, payload)
 
         def broadcast(op: str, payload: Any) -> None:
             for w in range(self.config.parallelism):
-                self._reply_to_spoke(net_id, w, op, payload)
+                self._reply_to_spoke(net_id, hub_id, w, op, payload)
 
         hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
         self.hubs[key] = hub

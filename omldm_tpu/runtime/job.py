@@ -97,9 +97,9 @@ class StreamJob:
             self.response_merger.add_fragment(frag)
 
     def _reply_to_spoke(
-        self, network_id: int, worker_id: int, op: str, payload: Any
+        self, network_id: int, hub_id: int, worker_id: int, op: str, payload: Any
     ) -> None:
-        self.spokes[worker_id].receive_from_hub(network_id, op, payload)
+        self.spokes[worker_id].receive_from_hub(network_id, hub_id, op, payload)
 
     # --- event handling ---
 

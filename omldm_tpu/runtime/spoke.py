@@ -246,10 +246,12 @@ class Spoke:
             net.node.on_flush()
             self.emit_query_response(net, TERMINATION_RESPONSE_ID)
 
-    def receive_from_hub(self, network_id: int, op: str, payload: Any) -> None:
+    def receive_from_hub(
+        self, network_id: int, hub_id: int, op: str, payload: Any
+    ) -> None:
         net = self.nets.get(network_id)
         if net is not None:
-            net.node.receive(op, payload)
+            net.node.receive(op, payload, hub_id)
 
     def mean_buffer_size(self) -> float:
         """getMeanBufferSize analogue (FlinkSpoke.scala:138): mean pending

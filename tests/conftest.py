@@ -2,19 +2,29 @@
 
 Multi-chip hardware is not available in CI; sharding/protocol tests run on 8
 virtual CPU devices (the TPU-native analogue of the reference's manual
-16-subtask workstation runs, hs_err_pid77107.log:21). Must set env before jax
-import anywhere in the process.
+16-subtask workstation runs, hs_err_pid77107.log:21).
+
+NOTE: this environment ships a jax build where the ``JAX_PLATFORMS`` env var
+is overridden by the platform plugin ('axon' TPU); only
+``jax.config.update("jax_platforms", ...)`` reliably selects the backend, and
+``XLA_FLAGS`` must be set before jax initializes its CPU client.
 """
 
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# replace (not merely keep) any preset device count: the suite requires 8
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
