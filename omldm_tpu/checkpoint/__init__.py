@@ -1,0 +1,5 @@
+"""Checkpoint / resume / rescale-merge."""
+
+from omldm_tpu.checkpoint.checkpoint import CheckpointManager
+
+__all__ = ["CheckpointManager"]

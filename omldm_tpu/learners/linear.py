@@ -84,6 +84,27 @@ class PAClassifier(Learner):
         new_w = params["w"] + (coef @ xb) / denom
         return {"w": new_w}, masked_mean(hinge, mask)
 
+    def update_per_record(self, params, x, y, mask):
+        """Exact sequential pass; with ``usePallas`` set, the fused VMEM
+        kernel (omldm_tpu.ops.pa_scan) replaces the generic lax.scan."""
+        if self.hp.get("usePallas"):
+            from omldm_tpu.ops.pa_scan import pa_scan_update
+
+            import jax as _jax
+
+            interpret = _jax.devices()[0].platform != "tpu"
+            new_w, loss = pa_scan_update(
+                params["w"],
+                append_bias(x),
+                y,
+                mask,
+                variant=str(self.hp.get("variant", "PA-I")),
+                C=float(self.hp.get("C", 0.01)),
+                interpret=interpret,
+            )
+            return {"w": new_w}, loss
+        return super().update_per_record(params, x, y, mask)
+
 
 class PARegressor(Learner):
     """Epsilon-insensitive Passive-Aggressive regressor (``RegressorPA``).

@@ -1,0 +1,1 @@
+"""Custom ops: pallas kernels + native host components."""

@@ -1,0 +1,13 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The reference's native layer is the ND4J/OpenBLAS tensor backend reached over
+JNI (SURVEY.md section 2.3); on TPU the tensor backend is XLA itself, so the
+native budget goes where the host is the bottleneck: stream ingest. The
+fast parser compiles ``fastparse.cpp`` with g++ into a shared object loaded
+via ctypes (no pybind11 in this image) and falls back to the pure-Python
+parser when a toolchain is unavailable.
+"""
+
+from omldm_tpu.ops.native.loader import FastParser, fast_parser_available
+
+__all__ = ["FastParser", "fast_parser_available"]

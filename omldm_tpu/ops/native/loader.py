@@ -1,0 +1,97 @@
+"""ctypes loader + on-demand build of the native record parser."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastparse.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libfastparse.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not os.path.exists(_LIB_PATH) or (
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+    ):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            _build_failed = True
+            return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.omldm_parse_lines.restype = ctypes.c_int
+    lib.omldm_parse_lines.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_ubyte),
+    ]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build()
+    return _lib
+
+
+def fast_parser_available() -> bool:
+    return _get_lib() is not None
+
+
+class FastParser:
+    """Bulk JSON-lines -> packed (x, y, op, valid) arrays.
+
+    ``valid`` semantics (see fastparse.cpp): 1 = parsed, 0 = dropped,
+    2 = needs the Python fallback (categorical features / metadata);
+    callers reparse flagged lines with ``DataInstance.from_json``."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native fast parser unavailable (g++ build failed)")
+        self._lib = lib
+
+    def parse(
+        self, data: bytes
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
+        n_lines = max(n_lines, 1)
+        x = np.zeros((n_lines, self.dim), np.float32)
+        y = np.zeros((n_lines,), np.float32)
+        op = np.zeros((n_lines,), np.uint8)
+        valid = np.zeros((n_lines,), np.uint8)
+        consumed = self._lib.omldm_parse_lines(
+            data,
+            len(data),
+            self.dim,
+            n_lines,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            op.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            valid.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        )
+        return x[:consumed], y[:consumed], op[:consumed], valid[:consumed]

@@ -39,7 +39,7 @@ Protocol mapping (SURVEY.md section 7 step 5):
 
 from __future__ import annotations
 
-import math
+import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -177,11 +177,17 @@ class SPMDTrainer:
             )
             for p, di in zip(self.preps, prep_dims)
         ]
-        flat_template, _ = jax.flatten_util.ravel_pytree(template)
-        flat_padded = np.concatenate(
-            [np.asarray(flat_template), np.zeros((self.pad,), np.float32)]
-        )
-        vec = stack(np.broadcast_to(flat_padded, (self.dp, self.flat_size)))
+        # drift estimates seed from each worker's OWN init (the host-plane
+        # nodes do the same in on_start): a shared template seed would make
+        # randomly-initialized learners (NN) register spurious drift and fire
+        # a violation sync before any training happened
+        per_worker_flat = np.zeros((self.dp, self.flat_size), np.float32)
+        for w in range(self.dp):
+            wf, _ = jax.flatten_util.ravel_pytree(
+                jax.tree_util.tree_map(lambda l: np.asarray(l)[w], params_dp)
+            )
+            per_worker_flat[w, : self.n_params] = np.asarray(wf)
+        vec = stack(per_worker_flat)
         zero = stack(np.zeros((self.dp,), np.float32))
         izero = stack(np.zeros((self.dp,), np.int32))
         return {
@@ -303,17 +309,9 @@ class SPMDTrainer:
                     (step_i % sync_every) == (w % sync_every), step_i >= 1
                 )
                 contrib = jnp.where(my_turn, flat - est, jnp.zeros_like(flat))
-                # shared global accumulates deltas scaled by 1/n (PS fold);
-                # routed through the hub shards like every other collective
-                i = jax.lax.axis_index("hub")
-                my = jax.lax.dynamic_slice(
-                    contrib, (i * self.shard_size,), (self.shard_size,)
-                )
-                folded = jax.lax.psum(my, "dp") / float(n_workers)
-                full_delta = _pvary(
-                    jax.lax.all_gather(folded, "hub", tiled=True), "dp"
-                )
-                center = center + full_delta
+                # shared global accumulates mean deltas (PS fold), routed
+                # through the hub shards like every other collective
+                center = center + self._ps_allreduce(contrib)
                 flat = jnp.where(my_turn, center, flat)
                 est = jnp.where(my_turn, center, est)
                 syncs = syncs + my_turn.astype(jnp.int32)
@@ -391,6 +389,27 @@ class SPMDTrainer:
                 )
             )
         return out
+
+    def save(self, directory: str) -> None:
+        """Orbax snapshot of the full fleet state (SURVEY.md section 7 step 8)."""
+        import orbax.checkpoint as ocp
+
+        host_state = jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), self.state
+        )
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(directory), host_state, force=True)
+
+    def load(self, directory: str) -> None:
+        """Restore fleet state saved by :meth:`save` (same mesh shape)."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        host_state = ckptr.restore(os.path.abspath(directory))
+        spec = NamedSharding(self.mesh, P("dp", "hub"))
+        self.state = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(jnp.asarray(leaf), spec), host_state
+        )
 
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """Loss/score of the worker-0 model on a host-side holdout set."""

@@ -1,0 +1,114 @@
+"""Bulk ingest through the native parser, with Python fallback.
+
+Replaces the per-record Python JSON path for file replay / bulk feeds: the
+C++ parser packs records straight into batch arrays; lines it flags
+(categorical features, metadata, odd schemas) are reparsed with the Python
+``DataInstance`` codec so drop/keep semantics match exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from omldm_tpu.api.data import FORECASTING, DataInstance
+from omldm_tpu.runtime.vectorizer import Vectorizer
+
+
+class PackedBatcher:
+    def __init__(self, dim: int, batch_size: int, hash_dims: int = 0):
+        self.dim = dim
+        self.batch_size = batch_size
+        self.vec = Vectorizer(dim, hash_dims)
+        try:
+            from omldm_tpu.ops.native import FastParser
+
+            self.parser: Optional[object] = FastParser(dim)
+        except (RuntimeError, ImportError):
+            self.parser = None
+        self._x = np.zeros((batch_size, dim), np.float32)
+        self._y = np.zeros((batch_size,), np.float32)
+        self._op = np.zeros((batch_size,), np.uint8)
+        self._n = 0
+
+    def _emit(self):
+        out = (
+            self._x[: self._n].copy(),
+            self._y[: self._n].copy(),
+            self._op[: self._n].copy(),
+        )
+        self._n = 0
+        return out
+
+    def _push(self, x_row, y_val, op_val):
+        self._x[self._n] = x_row
+        self._y[self._n] = y_val
+        self._op[self._n] = op_val
+        self._n += 1
+        if self._n >= self.batch_size:
+            return self._emit()
+        return None
+
+    def feed(self, block: bytes):
+        """Consume a byte block of whole JSON lines; yields full batches."""
+        if self.parser is not None:
+            x, y, op, valid = self.parser.parse(block)
+            lines = None
+            for i in range(x.shape[0]):
+                if valid[i] == 1:
+                    out = self._push(x[i], y[i], op[i])
+                    if out:
+                        yield out
+                elif valid[i] == 2:
+                    if lines is None:
+                        lines = block.split(b"\n")
+                    out = self._push_python(lines[i])
+                    if out:
+                        yield out
+        else:
+            for line in block.split(b"\n"):
+                out = self._push_python(line)
+                if out:
+                    yield out
+
+    def _push_python(self, line: bytes):
+        inst = DataInstance.from_json(line.decode("utf-8", errors="replace"))
+        if inst is None:
+            return None
+        return self._push(
+            self.vec.vectorize(inst),
+            0.0 if inst.target is None else inst.target,
+            1 if inst.operation == FORECASTING else 0,
+        )
+
+    def flush(self):
+        if self._n:
+            return self._emit()
+        return None
+
+
+def iter_file_batches(
+    path: str, dim: int, batch_size: int, hash_dims: int = 0,
+    chunk_bytes: int = 1 << 22,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Stream a JSON-lines file as packed (x, y, op) batches."""
+    b = PackedBatcher(dim, batch_size, hash_dims)
+    with open(path, "rb") as f:
+        leftover = b""
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            chunk = leftover + chunk
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                leftover = chunk
+                continue
+            leftover = chunk[cut + 1 :]
+            yield from b.feed(chunk[: cut + 1])
+        if leftover:
+            yield from b.feed(leftover + b"\n")
+    tail = b.flush()
+    if tail:
+        yield tail

@@ -70,6 +70,12 @@ class StreamJob:
         self._rr = 0  # round-robin data partitioner (the reference rebalances)
         self._pending_creates: List[Request] = []  # awaiting dim inference
         self._dims: dict = {}  # network_id -> feature dim
+        # opt-in periodic checkpointing (Job.scala:120, Checkpointing.scala)
+        self.checkpoint_manager = None
+        if self.config.checkpointing:
+            from omldm_tpu.checkpoint import CheckpointManager
+
+            self.checkpoint_manager = CheckpointManager(self.config.checkpoint_dir)
 
     # --- sinks ---
 
@@ -222,6 +228,8 @@ class StreamJob:
             if self.stats.terminated:
                 break
             self.process_event(stream, payload)
+            if self.checkpoint_manager is not None:
+                self.checkpoint_manager.maybe_save(self)
         if terminate_on_end and not self.stats.terminated:
             return self.terminate()
         return self.performance[-1] if self.performance else None

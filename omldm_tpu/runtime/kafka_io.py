@@ -1,0 +1,109 @@
+"""Kafka transport adapters (gated: no client library / broker required).
+
+Reference counterpart: the Kafka sources/sinks of ``KafkaUtils``
+(reference: src/main/scala/omldm/utils/KafkaUtils.scala:11-54) wiring the 7
+topics (trainingData, forecastingData, requests, psMessages, predictions,
+responses, performance — README.md:21-26, FlinkLearning.scala:53-59). In the
+TPU build the hub<->spoke feedback loop (psMessages) is in-process/ICI, so
+only the EXTERNAL topics need Kafka: records and requests in, predictions /
+responses / performance out.
+
+The adapters accept any object with the tiny protocols below, so tests (and
+non-Kafka deployments) can inject fakes; ``connect_kafka`` wires real clients
+when ``kafka-python`` or ``confluent_kafka`` is installed — neither ships in
+this image, hence the gate.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Iterator, Mapping, Optional, Tuple
+
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+)
+
+# topic-name defaults mirroring the reference (README.md:21-26)
+DEFAULT_TOPICS = {
+    "trainingData": TRAINING_STREAM,
+    "forecastingData": FORECASTING_STREAM,
+    "requests": REQUEST_STREAM,
+}
+DEFAULT_OUT_TOPICS = {
+    "predictions": "predictions",
+    "responses": "responses",
+    "performance": "performance",
+}
+
+
+def consumer_events(
+    consumer: Any,
+    topic_map: Optional[Mapping[str, str]] = None,
+) -> Iterator[Tuple[str, str]]:
+    """Adapt a Kafka-style consumer into the job's event iterable.
+
+    ``consumer`` must yield objects with ``.topic`` and ``.value`` (bytes or
+    str) — the shape of kafka-python's ConsumerRecord. Unknown topics are
+    skipped."""
+    topic_map = dict(topic_map or DEFAULT_TOPICS)
+    for record in consumer:
+        stream = topic_map.get(record.topic)
+        if stream is None:
+            continue
+        value = record.value
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", errors="replace")
+        yield (stream, value)
+
+
+class ProducerSinks:
+    """Producer-backed sinks for predictions / responses / performance.
+
+    ``producer`` must expose ``send(topic, value: bytes)`` (kafka-python
+    shape). Returns the three callbacks StreamJob accepts."""
+
+    def __init__(
+        self,
+        producer: Any,
+        out_topics: Optional[Mapping[str, str]] = None,
+    ):
+        self.producer = producer
+        self.topics = dict(out_topics or DEFAULT_OUT_TOPICS)
+
+    def _send(self, topic_key: str, obj: Any) -> None:
+        payload = obj.to_json() if hasattr(obj, "to_json") else json.dumps(obj)
+        self.producer.send(self.topics[topic_key], payload.encode())
+
+    def on_prediction(self, pred) -> None:
+        self._send("predictions", pred)
+
+    def on_response(self, resp) -> None:
+        self._send("responses", resp)
+
+    def on_performance(self, report) -> None:
+        self._send("performance", report)
+
+
+def connect_kafka(
+    brokers: str,
+    topic_map: Optional[Mapping[str, str]] = None,
+    out_topics: Optional[Mapping[str, str]] = None,
+) -> Tuple[Iterator[Tuple[str, str]], "ProducerSinks"]:
+    """Wire real Kafka clients. Requires kafka-python or confluent_kafka;
+    raises ImportError with guidance otherwise (neither library ships in
+    this image — use file replay / in-memory events instead)."""
+    try:
+        from kafka import KafkaConsumer, KafkaProducer  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "Kafka transport needs the 'kafka-python' package (or adapt "
+            "confluent_kafka to consumer_events/ProducerSinks); this "
+            "environment ships neither — use omldm_tpu.runtime.ingest "
+            "file replay or in-memory events."
+        ) from e
+    topic_map = dict(topic_map or DEFAULT_TOPICS)
+    consumer = KafkaConsumer(*topic_map.keys(), bootstrap_servers=brokers)
+    producer = KafkaProducer(bootstrap_servers=brokers)
+    return consumer_events(consumer, topic_map), ProducerSinks(producer, out_topics)

@@ -212,9 +212,11 @@ class Spoke:
         )
 
     def emit_query_response(self, net: SpokeNet, response_id: int) -> None:
-        """Evaluate on the holdout set and emit one QueryResponse fragment
-        (merged across workers by the ResponseMerger); model parameters are
-        bucketed by the network layer."""
+        """Evaluate on the holdout set and emit QueryResponse fragments —
+        one per <=max_param_bucket_size model-parameter bucket, the multi-part
+        response protocol of FlinkNetwork.sendQueryResponse
+        (FlinkNetwork.scala:48-149,151-240). The ResponseMerger re-assembles
+        buckets and averages metrics across workers."""
         net.flush_batch()
         test = net.test_arrays()
         if test is not None:
@@ -223,19 +225,37 @@ class Spoke:
             loss, score = 0.0, 0.0
         desc = net.pipeline.describe()
         qstats = net.node.query_stats()
-        self._emit_response(
-            QueryResponse(
-                response_id=response_id,
-                mlp_id=net.request.id,
-                preprocessors=desc["preprocessors"],
-                learner=desc["learner"],
-                protocol=net.protocol,
-                data_fitted=qstats["data_fitted"],
-                loss=loss,
-                cumulative_loss=qstats["cumulative_loss"],
-                score=score,
+
+        # model parameter buckets (termination probes skip the payload:
+        # responseId -1 fragments only feed statistics)
+        chunks: List[Optional[np.ndarray]] = [None]
+        if response_id != TERMINATION_RESPONSE_ID and not net.pipeline.learner.host_side:
+            flat, _ = net.pipeline.get_flat_params()
+            bucket = self.config.max_param_bucket_size
+            chunks = [
+                flat[i : i + bucket] for i in range(0, max(flat.size, 1), bucket)
+            ] or [None]
+        n_buckets = len(chunks)
+
+        for i, chunk in enumerate(chunks):
+            learner = dict(desc["learner"]) if i == 0 else {"name": desc["learner"]["name"]}
+            if chunk is not None:
+                learner["parameters"] = {"bucketValues": chunk.tolist()}
+            self._emit_response(
+                QueryResponse(
+                    response_id=response_id,
+                    mlp_id=net.request.id,
+                    bucket=i,
+                    num_buckets=n_buckets,
+                    preprocessors=desc["preprocessors"] if i == 0 else None,
+                    learner=learner,
+                    protocol=net.protocol if i == 0 else None,
+                    data_fitted=qstats["data_fitted"] if i == 0 else 0,
+                    loss=loss if i == 0 else None,
+                    cumulative_loss=qstats["cumulative_loss"] if i == 0 else None,
+                    score=score if i == 0 else None,
+                )
             )
-        )
 
     def handle_terminate_probe(self) -> None:
         """Termination probe: flush + evaluate every net, emit responseId -1

@@ -1,0 +1,83 @@
+"""Kafka adapter tests with fake clients (no broker)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.kafka_io import ProducerSinks, connect_kafka, consumer_events
+
+
+@dataclasses.dataclass
+class FakeRecord:
+    topic: str
+    value: bytes
+
+
+class FakeProducer:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, topic, value):
+        self.sent.append((topic, value))
+
+
+def test_full_job_over_fake_kafka():
+    rng = np.random.RandomState(0)
+    w = rng.randn(4)
+    records = [
+        FakeRecord(
+            "requests",
+            json.dumps(
+                {
+                    "id": 0,
+                    "request": "Create",
+                    "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+                    "trainingConfiguration": {"protocol": "CentralizedTraining"},
+                }
+            ).encode(),
+        )
+    ]
+    for i in range(600):
+        x = rng.randn(4)
+        records.append(
+            FakeRecord(
+                "trainingData",
+                json.dumps(
+                    {"numericalFeatures": list(np.round(x, 4)), "target": float(x @ w > 0)}
+                ).encode(),
+            )
+        )
+    records.append(FakeRecord("ignoredTopic", b"junk"))
+    for i in range(5):
+        x = rng.randn(4)
+        records.append(
+            FakeRecord(
+                "forecastingData",
+                json.dumps({"id": i, "numericalFeatures": list(np.round(x, 4))}).encode(),
+            )
+        )
+
+    producer = FakeProducer()
+    sinks = ProducerSinks(producer)
+    job = StreamJob(
+        JobConfig(parallelism=1, batch_size=32, test_set_size=32),
+        on_prediction=sinks.on_prediction,
+        on_response=sinks.on_response,
+        on_performance=sinks.on_performance,
+    )
+    job.run(consumer_events(iter(records)))
+
+    topics = [t for t, _ in producer.sent]
+    assert topics.count("predictions") == 5
+    assert topics.count("performance") == 1
+    perf = json.loads([v for t, v in producer.sent if t == "performance"][0])
+    assert perf["statistics"][0]["fitted"] > 300
+
+
+def test_connect_kafka_gated():
+    with pytest.raises(ImportError, match="kafka-python"):
+        connect_kafka("localhost:9092")
