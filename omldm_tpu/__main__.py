@@ -1,0 +1,152 @@
+"""CLI entry point: ``python -m omldm_tpu [--flag value ...]``.
+
+Reference counterpart: ``Job.main(args)`` (reference:
+src/main/scala/omldm/Job.scala:110-171) — parse ``--key value`` CLI flags
+with ``ParameterTool.fromArgs`` semantics (Job.scala:114), build the sources
+and sinks, assemble the job graph, and run it. The reference's flag surface
+(README.md:28-41) is per-topic Kafka name+broker pairs plus the job knobs
+(``parallelism``, ``test``, ``maxMsgParams``, ``jobName``, ``timeout``,
+``testSetSize``, ``checkpointing``, ``checkInterval``, ``stateBackend``);
+all job knobs are accepted here with the same names (JobConfig.from_args).
+
+Sources (choose one style):
+
+- ``--trainingData path.jsonl`` / ``--forecastingData path.jsonl`` /
+  ``--requests path.jsonl`` — JSON-lines file replay, round-robin
+  interleaved (the deterministic stand-in for stream union, Job.scala:70);
+  an ``EOS`` line stops a file (DataInstanceParser.scala:14).
+- ``--events combined.jsonl`` — one fully-ordered file of
+  ``{"stream": "trainingData"|"forecastingData"|"requests", "data": {...}}``
+  lines, when the exact arrival order matters (e.g. Query after training).
+- ``--kafkaBrokers host:port`` — live Kafka consumer/producer via
+  omldm_tpu.runtime.kafka_io (requires kafka-python; silence-timer
+  termination as in StatisticsOperator.scala:135-142).
+
+Sinks: ``--predictionsOut`` / ``--responsesOut`` / ``--performanceOut``
+write JSON lines to files (default: performance to stdout, mirroring the
+reference's PerformanceWriter -> performance topic, FlinkLearning.scala:137-144).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime.ingest import file_events, interleave
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+    StreamJob,
+)
+
+_STREAMS = (TRAINING_STREAM, FORECASTING_STREAM, REQUEST_STREAM)
+
+
+def parse_flags(argv: List[str]) -> Dict[str, str]:
+    """``--key value`` pairs -> dict (ParameterTool.fromArgs, Job.scala:114).
+    A flag without a value is treated as boolean true."""
+    flags: Dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if not arg.startswith("--"):
+            raise SystemExit(f"expected --flag, got {arg!r}")
+        key = arg[2:]
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+            flags[key] = argv[i + 1]
+            i += 2
+        else:
+            flags[key] = "true"
+            i += 1
+    return flags
+
+
+def combined_events(path: str) -> Iterator[Tuple[str, str]]:
+    """Replay a fully-ordered combined event file: each line is
+    ``{"stream": <topic>, "data": <record object or JSON string>}``."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            stream = obj.get("stream")
+            if stream not in _STREAMS:
+                continue
+            data = obj.get("data")
+            yield (stream, data if isinstance(data, str) else json.dumps(data))
+
+
+class _FileSink:
+    def __init__(self, path: Optional[str], default=None):
+        self._f = open(path, "w") if path else default
+
+    def __call__(self, obj: Any) -> None:
+        if self._f is None:
+            return
+        payload = obj.to_json() if hasattr(obj, "to_json") else json.dumps(obj)
+        self._f.write(payload + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None and self._f not in (sys.stdout, sys.stderr):
+            self._f.close()
+
+
+def build_job(flags: Dict[str, str]) -> Tuple[StreamJob, List[_FileSink]]:
+    config = JobConfig.from_args(flags)
+    pred_sink = _FileSink(flags.get("predictionsOut"))
+    resp_sink = _FileSink(flags.get("responsesOut"))
+    perf_sink = _FileSink(flags.get("performanceOut"), default=sys.stdout)
+    job = StreamJob(
+        config,
+        on_prediction=pred_sink,
+        on_response=resp_sink,
+        on_performance=perf_sink,
+    )
+    return job, [pred_sink, resp_sink, perf_sink]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    flags = parse_flags(sys.argv[1:] if argv is None else argv)
+    job, sinks = build_job(flags)
+    try:
+        if "kafkaBrokers" in flags:
+            from omldm_tpu.runtime.kafka_io import connect_kafka
+
+            events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
+            job._on_prediction = producer_sinks.on_prediction
+            job._on_response = producer_sinks.on_response
+            job._on_performance = producer_sinks.on_performance
+            for stream, payload in events:
+                job.process_event(stream, payload)
+                if job.checkpoint_manager is not None:
+                    job.checkpoint_manager.maybe_save(job)
+                if job.check_silence() is not None:
+                    break
+        elif "events" in flags:
+            job.run(combined_events(flags["events"]))
+        else:
+            sources = [
+                file_events(flags[topic], topic)
+                for topic in _STREAMS
+                if topic in flags
+            ]
+            if not sources:
+                raise SystemExit(
+                    "no sources: pass --trainingData/--forecastingData/"
+                    "--requests <path.jsonl>, --events <combined.jsonl>, "
+                    "or --kafkaBrokers <host:port>"
+                )
+            job.run(interleave(*sources))
+    finally:
+        for sink in sinks:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,11 @@ class QueryResponse:
     loss: Optional[float] = None
     cumulative_loss: Optional[float] = None
     score: Optional[float] = None
+    # internal routing metadata (NOT part of the wire format): which worker
+    # emitted this fragment — lets the merger re-assemble parameter buckets
+    # from a single replica's fragment set even when replicas differ
+    # (async protocols between syncs)
+    source_worker: Optional[int] = None
 
     @classmethod
     def from_dict(cls, obj: Mapping[str, Any]) -> "QueryResponse":

@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from omldm_tpu.api.requests import Request
@@ -143,7 +144,6 @@ class CheckpointManager:
             snapshot = pickle.load(f)
 
         config = JobConfig(**snapshot["config"])
-        old_parallelism = config.parallelism
         if parallelism is not None:
             config.parallelism = parallelism
         job = StreamJob(config)
@@ -157,11 +157,9 @@ class CheckpointManager:
                     job._deploy(request, dim)
 
         for net_id_key in {k for nets in snapshot["spokes"] for k in nets}:
-            self._restore_network(job, snapshot, net_id_key, old_parallelism)
+            self._restore_network(job, snapshot, net_id_key)
 
         # protocol statistics continuity (counters keep accumulating)
-        from omldm_tpu.api.stats import Statistics
-
         for net_id, sd in snapshot["hub_stats"].items():
             hub = job.hub_manager.hubs.get((int(net_id), 0))
             if hub is not None:
@@ -174,7 +172,7 @@ class CheckpointManager:
                 s.lcx = list(sd["LCX"])
         return job
 
-    def _restore_network(self, job, snapshot, net_id: int, old_parallelism: int):
+    def _restore_network(self, job, snapshot, net_id: int):
         saved = [
             nets[net_id] for nets in snapshot["spokes"] if net_id in nets
         ]
@@ -215,6 +213,11 @@ class CheckpointManager:
                     _fresh_copy, merged_preps[i]
                 )
             pipe._fitted_host = total_fitted // len(new_spokes)
+            # distribute the summed cumulative loss evenly so the job-wide
+            # sum (and hence termination-stats totals) carries across rescale
+            pipe.state["cum_loss"] = jnp.asarray(
+                total_cum_loss / len(new_spokes), jnp.float32
+            )
             net.holdout_count = max(sv["holdout_count"] for sv in saved)
 
         # ...and redistribute holdout points + pending records round-robin;
@@ -237,6 +240,7 @@ class CheckpointManager:
         pipe = net.pipeline
         pipe.state["params"] = sv["params"]
         pipe.state["preps"] = list(sv["preps"])
+        pipe.state["cum_loss"] = jnp.asarray(sv["cum_loss"], jnp.float32)
         pipe._fitted_host = sv["fitted"]
         net.holdout_count = sv["holdout_count"]
         for p in sv["test_set"]:

@@ -188,13 +188,21 @@ class SPMDTrainer:
             )
             per_worker_flat[w, : self.n_params] = np.asarray(wf)
         vec = stack(per_worker_flat)
+        # the center (EASGD center variable / async-SSP shared global) is PS
+        # state: it must start IDENTICAL on every worker — its updates are
+        # pure collectives, so replicas only stay in agreement if they agree
+        # at step 0. Seed it with the fleet-mean init.
+        center0 = np.broadcast_to(
+            per_worker_flat.mean(axis=0, keepdims=True),
+            per_worker_flat.shape,
+        )
         zero = stack(np.zeros((self.dp,), np.float32))
         izero = stack(np.zeros((self.dp,), np.int32))
         return {
             "params": params,
             "preps": preps,
             "est": vec.copy(),     # estimate at last sync (GM/FGM/async base)
-            "center": vec.copy(),  # EASGD center / async-SSP global
+            "center": stack(center0),  # EASGD center / async-SSP global
             "step": izero.copy(),
             "syncs": izero.copy(),
             "cum_loss": zero.copy(),

@@ -24,7 +24,10 @@ class PackedBatcher:
         try:
             from omldm_tpu.ops.native import FastParser
 
-            self.parser: Optional[object] = FastParser(dim)
+            # the C parser packs dense features only; cap it at the dense
+            # budget so the trailing hash_dims slots (reserved for hashed
+            # categoricals) stay zero, matching the Vectorizer layout
+            self.parser: Optional[object] = FastParser(dim - hash_dims)
         except (RuntimeError, ImportError):
             self.parser = None
         self._x = np.zeros((batch_size, dim), np.float32)
@@ -42,7 +45,9 @@ class PackedBatcher:
         return out
 
     def _push(self, x_row, y_val, op_val):
-        self._x[self._n] = x_row
+        w = x_row.shape[0]
+        self._x[self._n, :w] = x_row
+        self._x[self._n, w:] = 0.0
         self._y[self._n] = y_val
         self._op[self._n] = op_val
         self._n += 1

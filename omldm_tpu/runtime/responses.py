@@ -8,7 +8,7 @@ then merges: keeps the last non-null learner/preprocessors/protocol, sums
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from omldm_tpu.api.responses import QueryResponse
 
@@ -66,12 +66,23 @@ class ResponseMerger:
         out.loss = sum((f.loss or 0.0) for f in heads) / n
         out.cumulative_loss = sum((f.cumulative_loss or 0.0) for f in heads) / n
         out.score = sum((f.score or 0.0) for f in heads) / n
-        # re-assemble parameter buckets from one worker's fragment set
-        buckets: Dict[int, list] = {}
+        # re-assemble parameter buckets from ONE worker's fragment set —
+        # grouping by source worker, since async-protocol replicas may
+        # legitimately differ between syncs and interleaving chunks from
+        # different replicas would fabricate a model no worker ever held
+        by_source: Dict[Any, Dict[int, list]] = {}
         for f in frags:
             chunk = (f.learner or {}).get("parameters", {}).get("bucketValues")
-            if chunk is not None and f.bucket not in buckets:
-                buckets[f.bucket] = chunk
+            if chunk is not None:
+                src = by_source.setdefault(f.source_worker, {})
+                src.setdefault(f.bucket, chunk)
+        buckets: Dict[int, list] = {}
+        for src in by_source.values():
+            if len(src) >= max(out.num_buckets, 1):
+                buckets = src
+                break
+        if not buckets and by_source:
+            buckets = max(by_source.values(), key=len)
         if buckets and out.learner is not None:
             values: list = []
             for i in sorted(buckets):

@@ -254,6 +254,7 @@ class Spoke:
                     loss=loss if i == 0 else None,
                     cumulative_loss=qstats["cumulative_loss"] if i == 0 else None,
                     score=score if i == 0 else None,
+                    source_worker=self.worker_id,
                 )
             )
 

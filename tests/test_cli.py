@@ -1,0 +1,122 @@
+"""CLI entry-point tests: ``python -m omldm_tpu`` file-replay jobs
+(the Job.main analogue, reference Job.scala:110-171)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.__main__ import build_job, combined_events, main, parse_flags
+
+
+def _write_stream(path, n=800, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim)
+    y = (x @ w > 0).astype(float)
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(
+                json.dumps(
+                    {
+                        "numericalFeatures": list(np.round(x[i], 5)),
+                        "target": y[i],
+                        "operation": "training",
+                    }
+                )
+                + "\n"
+            )
+        f.write("EOS\n")
+    return x, y
+
+
+CREATE = {
+    "id": 0,
+    "request": "Create",
+    "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+    "preProcessors": [],
+    "trainingConfiguration": {"protocol": "CentralizedTraining"},
+}
+
+
+class TestParseFlags:
+    def test_pairs_and_booleans(self):
+        flags = parse_flags(
+            ["--parallelism", "4", "--test", "--jobName", "run1"]
+        )
+        assert flags == {"parallelism": "4", "test": "true", "jobName": "run1"}
+
+    def test_rejects_positional(self):
+        with pytest.raises(SystemExit):
+            parse_flags(["oops"])
+
+
+class TestFileReplayJob:
+    def test_end_to_end_files(self, tmp_path):
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "requests.jsonl"
+        perf = tmp_path / "perf.jsonl"
+        _write_stream(str(train))
+        reqs.write_text(json.dumps(CREATE) + "\n")
+        rc = main(
+            [
+                "--trainingData", str(train),
+                "--requests", str(reqs),
+                "--performanceOut", str(perf),
+                "--parallelism", "2",
+                "--batchSize", "64",
+                "--testSetSize", "32",
+            ]
+        )
+        assert rc == 0
+        [line] = perf.read_text().strip().splitlines()
+        report = json.loads(line)
+        [stats] = report["statistics"]
+        assert stats["pipeline"] == 0
+        assert stats["fitted"] > 400
+
+    def test_combined_events_preserves_order(self, tmp_path):
+        combined = tmp_path / "events.jsonl"
+        resp_out = tmp_path / "responses.jsonl"
+        rng = np.random.RandomState(1)
+        dim, n = 4, 600
+        w = rng.randn(dim)
+        lines = [{"stream": "requests", "data": CREATE}]
+        for i in range(n):
+            x = rng.randn(dim)
+            lines.append(
+                {
+                    "stream": "trainingData",
+                    "data": {
+                        "numericalFeatures": list(np.round(x, 5)),
+                        "target": float(x @ w > 0),
+                        "operation": "training",
+                    },
+                }
+            )
+        # Query arrives AFTER training — combined mode must preserve that
+        lines.append(
+            {
+                "stream": "requests",
+                "data": {"id": 0, "request": "Query", "requestId": 7},
+            }
+        )
+        combined.write_text("\n".join(json.dumps(l) for l in lines))
+        rc = main(
+            [
+                "--events", str(combined),
+                "--responsesOut", str(resp_out),
+                "--performanceOut", str(tmp_path / "perf.jsonl"),
+                "--parallelism", "1",
+                "--batchSize", "32",
+            ]
+        )
+        assert rc == 0
+        responses = [
+            json.loads(l) for l in resp_out.read_text().strip().splitlines()
+        ]
+        assert any(r["responseId"] == 7 for r in responses)
+
+    def test_no_sources_exits(self):
+        with pytest.raises(SystemExit):
+            main(["--parallelism", "2"])

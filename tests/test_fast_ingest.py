@@ -111,3 +111,24 @@ class TestIterFileBatches:
         np.testing.assert_allclose(x[0], [1, 2, 0, 0])
         assert x[1][0] == 3.0 and np.abs(x[1][2:]).sum() > 0  # hashed cat
         np.testing.assert_allclose(x[2], [5, 6, 0, 0])
+
+
+class TestHashDimsLayout:
+    def test_c_and_python_paths_agree_with_hash_dims(self):
+        """Dense features must stay in the first dim - hash_dims slots on
+        BOTH parse paths; the trailing hashed-categorical region is reserved
+        (regression: the C parser used to pack into the full width)."""
+        from omldm_tpu.runtime.fast_ingest import PackedBatcher
+
+        line = b'{"numericalFeatures": [1, 2, 3], "target": 1}\n'
+        with_parser = PackedBatcher(dim=4, batch_size=1, hash_dims=2)
+        without = PackedBatcher(dim=4, batch_size=1, hash_dims=2)
+        without.parser = None  # force the Python fallback
+        if with_parser.parser is None:
+            import pytest
+
+            pytest.skip("native parser unavailable")
+        (bx, _, _), = list(with_parser.feed(line))
+        (px, _, _), = list(without.feed(line))
+        np.testing.assert_allclose(bx, px)
+        np.testing.assert_allclose(bx[0], [1.0, 2.0, 0.0, 0.0])
