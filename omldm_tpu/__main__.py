@@ -118,13 +118,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             from omldm_tpu.runtime.kafka_io import connect_kafka
 
             events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
-            job._on_prediction = producer_sinks.on_prediction
-            job._on_response = producer_sinks.on_response
-            job._on_performance = producer_sinks.on_performance
-            for stream, payload in events:
-                job.process_event(stream, payload)
-                if job.checkpoint_manager is not None:
-                    job.checkpoint_manager.maybe_save(job)
+            # Kafka producers are the default egress; an explicitly-passed
+            # file sink keeps precedence over the producer for its stream
+            job.set_sinks(
+                on_prediction=(
+                    None if "predictionsOut" in flags
+                    else producer_sinks.on_prediction
+                ),
+                on_response=(
+                    None if "responsesOut" in flags
+                    else producer_sinks.on_response
+                ),
+                on_performance=(
+                    None if "performanceOut" in flags
+                    else producer_sinks.on_performance
+                ),
+            )
+            for event in events:  # yields None on each idle poll window
+                if event is not None:
+                    job.process_event(*event)
+                    if job.checkpoint_manager is not None:
+                        job.checkpoint_manager.maybe_save(job)
                 if job.check_silence() is not None:
                     break
         elif "events" in flags:

@@ -1,0 +1,253 @@
+"""Attention kernels: reference, blockwise (flash-style), and Pallas TPU.
+
+The reference has no attention anywhere (SURVEY.md section 2.4 — its models
+are per-record online learners over feature vectors), but long-context
+sequence models are first-class in this framework: the transformer family
+(omldm_tpu.models.transformer) and sequence/context parallelism
+(omldm_tpu.ops.ring_attention) are built on the kernels here.
+
+Three implementations, one contract ``[B, L, H, Dh] -> [B, L, H, Dh]``:
+
+- ``mha_reference``      — materializes the full [L, L] score matrix; O(L^2)
+                           memory; ground truth for tests.
+- ``blockwise_attention``— flash-style online-softmax over K/V blocks via
+                           ``lax.scan``: O(L * block) memory, numerically
+                           identical (up to fp assoc.) to the reference.
+                           Works on every backend; this is also the
+                           per-device inner loop of ring attention.
+- ``flash_attention_pallas`` — hand-tiled Pallas TPU kernel keeping the
+                           Q block + online-softmax accumulators in VMEM;
+                           ``interpret=True`` runs it on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jnp.ndarray:
+    """Plain softmax attention. q,k,v: [B, L, H, Dh].
+
+    ``q_offset``/``kv_offset`` give the absolute positions of the first query
+    / key row — used by the blockwise and ring variants to apply a causal
+    mask across chunk boundaries."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(dh))
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])[:, None]
+        ki = kv_offset + jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(qi >= ki, scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_k: int = 256,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jnp.ndarray:
+    """Flash-style attention: scan over K/V blocks with online softmax.
+
+    q,k,v: [B, L, H, Dh] (Lk may differ from Lq). Never materializes the
+    [Lq, Lk] matrix; peak memory is O(Lq * block_k) per head."""
+    b, lq, h, dh = q.shape
+    lk = k.shape[1]
+    block_k = min(block_k, lk)
+    pad = (-lk) % block_k
+    if pad:
+        # padded keys are masked out via an explicit finite bias so that a
+        # fully-masked block still produces well-defined (zero) weights
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_blocks = (lk + pad) // block_k
+    kb = k.reshape(b, n_blocks, block_k, h, dh).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block_k, h, dh).transpose(1, 0, 2, 3, 4)
+
+    scale = 1.0 / jnp.sqrt(float(dh))
+    q_pos = q_offset + jnp.arange(lq)
+    o0 = jnp.zeros((b, h, lq, dh), jnp.float32)
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+
+    def scan_step(carry, kv):
+        o, m, l, step = carry
+        kb_i, vb_i = kv
+        if pad:
+            # mask pad rows of the (only) ragged final block
+            ki_local = step * block_k + jnp.arange(block_k)
+            valid = (ki_local < lk).astype(jnp.float32)
+            vb_i = vb_i * valid[None, :, None, None]
+            kbias = jnp.where(ki_local < lk, 0.0, NEG_INF)
+        else:
+            kbias = None
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kb_i.astype(jnp.float32)) * scale
+        if kbias is not None:
+            s = s + kbias[None, None, None, :]
+        if causal:
+            ki = kv_offset + step * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos[:, None] >= ki, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard: a row with every key masked so far (m_new still -inf) must
+        # produce zero weights, not exp(0)=1 per masked key
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb_i.astype(jnp.float32)
+        )
+        return (o_new, m_new, l_new, step + 1), None
+
+    (o, m, l, _), _ = jax.lax.scan(scan_step, (o0, m0, l0, jnp.int32(0)), (kb, vb))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Lq, H, Dh]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash-attention kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  q_offset: int, kv_offset: int, lk: int):
+    """Grid: (B*H, Lq/block_q). Each program owns one Q tile and sweeps all
+    K/V blocks keeping the online-softmax accumulators in VMEM."""
+    block_q, dh = q_ref.shape
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)  # [bq, dh]
+    scale = 1.0 / jnp.sqrt(float(dh))
+
+    n_blocks = pl.cdiv(lk, block_k)
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        ki_local = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(ki_local < lk, s, NEG_INF)
+        if causal:
+            q_pos = (
+                q_offset + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            s = jnp.where(q_pos >= kv_offset + ki_local, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # same fully-masked-row guard as the blockwise/ring variants
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[:, None]))
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, dh), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+    o_ref[...] = (o / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "q_offset", "kv_offset", "interpret"),
+)
+def flash_attention_pallas(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas flash attention. q,k,v: [B, L, H, Dh] -> [B, Lq, H, Dh].
+
+    The grid is (B*H, ceil(Lq/block_q)); K/V live in VMEM per (batch, head)
+    program and are streamed block_k rows at a time through the MXU. Use
+    ``interpret=True`` on CPU."""
+    b, lq, h, dh = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    pad_q = (-lq) % block_q
+    pad_k = (-lk) % block_k
+
+    # flatten (B, H) into the leading grid axis; pallas BlockSpec tiles Lq
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, dh)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, dh)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, dh)
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+
+    grid = (b * h, (lq + pad_q) // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_k=block_k,
+            causal=causal,
+            q_offset=q_offset,
+            kv_offset=kv_offset,
+            lk=lk,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, dh), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, lk + pad_k, dh), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, lk + pad_k, dh), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, dh), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out[:, :lq].reshape(b, h, lq, dh).transpose(0, 2, 1, 3)
+    return out
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    block_k: int = 256,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Backend-dispatching attention entry point: the Pallas kernel on TPU,
+    blockwise scan elsewhere."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        return flash_attention_pallas(
+            q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset
+        )
+    return blockwise_attention(
+        q, k, v, causal=causal, block_k=block_k,
+        q_offset=q_offset, kv_offset=kv_offset,
+    )

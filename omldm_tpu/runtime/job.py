@@ -79,6 +79,21 @@ class StreamJob:
 
     # --- sinks ---
 
+    def set_sinks(
+        self,
+        on_prediction: Optional[Callable[[Prediction], None]] = None,
+        on_response: Optional[Callable[[QueryResponse], None]] = None,
+        on_performance: Optional[Callable[[JobStatistics], None]] = None,
+    ) -> None:
+        """Override output sinks after construction; only the callbacks
+        passed (non-None) are replaced."""
+        if on_prediction is not None:
+            self._on_prediction = on_prediction
+        if on_response is not None:
+            self._on_response = on_response
+        if on_performance is not None:
+            self._on_performance = on_performance
+
     def _emit_prediction(self, pred: Prediction) -> None:
         self.predictions.append(pred)
         if self._on_prediction:

@@ -58,6 +58,34 @@ def consumer_events(
         yield (stream, value)
 
 
+def polling_events(
+    consumer: Any,
+    topic_map: Optional[Mapping[str, str]] = None,
+) -> Iterator[Optional[Tuple[str, str]]]:
+    """Adapt a poll-style Kafka consumer into a NEVER-ENDING event iterable
+    that yields ``None`` whenever a poll window elapses with no message.
+
+    ``consumer`` must support ``next(consumer)`` raising ``StopIteration``
+    on an idle window (kafka-python's behavior when ``consumer_timeout_ms``
+    is set; each subsequent ``next`` resumes fetching). The ``None`` idle
+    markers let the driver run the silence-timer termination check
+    (StatisticsOperator.scala:135-142) even when the broker goes quiet."""
+    topic_map = dict(topic_map or DEFAULT_TOPICS)
+    while True:
+        try:
+            record = next(consumer)
+        except StopIteration:
+            yield None
+            continue
+        stream = topic_map.get(record.topic)
+        if stream is None:
+            continue
+        value = record.value
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", errors="replace")
+        yield (stream, value)
+
+
 class ProducerSinks:
     """Producer-backed sinks for predictions / responses / performance.
 
@@ -90,7 +118,8 @@ def connect_kafka(
     brokers: str,
     topic_map: Optional[Mapping[str, str]] = None,
     out_topics: Optional[Mapping[str, str]] = None,
-) -> Tuple[Iterator[Tuple[str, str]], "ProducerSinks"]:
+    poll_timeout_ms: int = 1000,
+) -> Tuple[Iterator[Optional[Tuple[str, str]]], "ProducerSinks"]:
     """Wire real Kafka clients. Requires kafka-python or confluent_kafka;
     raises ImportError with guidance otherwise (neither library ships in
     this image — use file replay / in-memory events instead)."""
@@ -104,6 +133,13 @@ def connect_kafka(
             "file replay or in-memory events."
         ) from e
     topic_map = dict(topic_map or DEFAULT_TOPICS)
-    consumer = KafkaConsumer(*topic_map.keys(), bootstrap_servers=brokers)
+    # consumer_timeout_ms bounds each poll so the iterator goes idle (raises
+    # StopIteration, resumable) instead of blocking forever — required for
+    # the silence-timer termination to ever fire on a quiet broker
+    consumer = KafkaConsumer(
+        *topic_map.keys(),
+        bootstrap_servers=brokers,
+        consumer_timeout_ms=poll_timeout_ms,
+    )
     producer = KafkaProducer(bootstrap_servers=brokers)
-    return consumer_events(consumer, topic_map), ProducerSinks(producer, out_topics)
+    return polling_events(consumer, topic_map), ProducerSinks(producer, out_topics)

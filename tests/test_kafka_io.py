@@ -8,7 +8,12 @@ import pytest
 
 from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime import StreamJob
-from omldm_tpu.runtime.kafka_io import ProducerSinks, connect_kafka, consumer_events
+from omldm_tpu.runtime.kafka_io import (
+    ProducerSinks,
+    connect_kafka,
+    consumer_events,
+    polling_events,
+)
 
 
 @dataclasses.dataclass
@@ -81,3 +86,45 @@ def test_full_job_over_fake_kafka():
 def test_connect_kafka_gated():
     with pytest.raises(ImportError, match="kafka-python"):
         connect_kafka("localhost:9092")
+
+
+class FakePollingConsumer:
+    """kafka-python shape with consumer_timeout_ms: next() raises
+    StopIteration on an idle window but subsequent next() calls resume."""
+
+    def __init__(self, windows):
+        # windows: list of lists of FakeRecord; each gap between lists is an
+        # idle poll window
+        self._flat = []
+        for w in windows:
+            self._flat.extend(w)
+            self._flat.append(None)  # idle marker -> StopIteration
+
+    def __next__(self):
+        if not self._flat:
+            raise StopIteration
+        item = self._flat.pop(0)
+        if item is None:
+            raise StopIteration
+        return item
+
+
+def test_polling_events_yields_idle_markers():
+    """The polling adapter never ends: quiet windows come out as None so the
+    driver can run the silence-timer termination check."""
+    consumer = FakePollingConsumer(
+        [
+            [FakeRecord("trainingData", b"{}")],
+            [],  # pure idle window
+            [FakeRecord("requests", b"{}"), FakeRecord("unknownTopic", b"x")],
+        ]
+    )
+    events = polling_events(consumer)
+    seen = [next(events) for _ in range(5)]
+    assert seen[0] == ("trainingData", "{}")
+    assert seen[1] is None  # first idle window
+    assert seen[2] is None  # the empty window
+    assert seen[3] == ("requests", "{}")  # unknown topic skipped silently
+    assert seen[4] is None
+    # exhausted fake keeps signalling idle forever — the iterator never ends
+    assert next(events) is None
