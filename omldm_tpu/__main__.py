@@ -134,6 +134,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     else producer_sinks.on_performance
                 ),
             )
+            # start the silence clock at loop entry so a broker that never
+            # delivers anything still terminates after the timeout
+            job.stats.mark_activity()
             for event in events:  # yields None on each idle poll window
                 if event is not None:
                     job.process_event(*event)

@@ -1,0 +1,19 @@
+"""Sequence-model family: TPU-native transformers (dense + MoE).
+
+The reference has no sequence models (SURVEY.md section 2.4 — its learners
+are per-record online models over feature vectors); this package is the
+framework's long-context extension, built on the attention kernels in
+omldm_tpu.ops and sharded by omldm_tpu.parallel.seq_trainer.
+"""
+
+from omldm_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    transformer_forward,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_transformer",
+    "transformer_forward",
+]

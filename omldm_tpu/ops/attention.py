@@ -83,18 +83,20 @@ def blockwise_attention(
 
     scale = 1.0 / jnp.sqrt(float(dh))
     q_pos = q_offset + jnp.arange(lq)
-    o0 = jnp.zeros((b, h, lq, dh), jnp.float32)
-    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    # derive accumulators from q so that, under shard_map, they inherit its
+    # varying-axis type (scan requires matching carry types)
+    zq = jnp.transpose(q.astype(jnp.float32) * 0.0, (0, 2, 1, 3))  # [B,H,Lq,Dh]
+    o0 = zq
+    m0 = zq[..., 0] + NEG_INF
+    l0 = zq[..., 0]
 
     def scan_step(carry, kv):
         o, m, l, step = carry
         kb_i, vb_i = kv
         if pad:
-            # mask pad rows of the (only) ragged final block
+            # mask pad rows of the (only) ragged final block; the NEG_INF
+            # bias alone suffices — p is exactly 0 for padded keys
             ki_local = step * block_k + jnp.arange(block_k)
-            valid = (ki_local < lk).astype(jnp.float32)
-            vb_i = vb_i * valid[None, :, None, None]
             kbias = jnp.where(ki_local < lk, 0.0, NEG_INF)
         else:
             kbias = None
@@ -229,6 +231,40 @@ def flash_attention_pallas(
     return out
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal: bool, q_offset: int, kv_offset: int,
+                interpret: bool = False):
+    """Differentiable wrapper: Pallas forward, blockwise-derived backward
+    (flash backward recomputes attention anyway; the blockwise VJP is the
+    same O(L * block) memory)."""
+    return flash_attention_pallas(
+        q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+        interpret=interpret,
+    )
+
+
+def _flash_diff_fwd(q, k, v, causal, q_offset, kv_offset, interpret=False):
+    out = flash_attention_pallas(
+        q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -239,14 +275,12 @@ def attention(
     block_k: int = 256,
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Backend-dispatching attention entry point: the Pallas kernel on TPU,
-    blockwise scan elsewhere."""
+    """Backend-dispatching attention entry point: the Pallas kernel on TPU
+    (differentiable via a blockwise-derived VJP), blockwise scan elsewhere."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
-        return flash_attention_pallas(
-            q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset
-        )
+        return _flash_diff(q, k, v, causal, q_offset, kv_offset)
     return blockwise_attention(
         q, k, v, causal=causal, block_k=block_k,
         q_offset=q_offset, kv_offset=kv_offset,

@@ -1,0 +1,200 @@
+"""SeqTrainer: dp x sp x tp (+ expert-parallel) transformer training.
+
+The long-context/distributed counterpart of :class:`SPMDTrainer`
+(omldm_tpu.parallel.spmd) for the sequence-model family: one jitted,
+donated ``shard_map`` step over a 3-axis ``("dp", "sp", "tp")`` mesh —
+
+- batch split over ``dp`` (gradients reduced by the global-mean loss psum);
+- sequence split over ``sp`` with ring attention rotating K/V over ICI;
+- heads / MLP hidden (Megatron layout) split over ``tp`` with one psum per
+  block; MoE experts split over the ``dp`` axis (expert parallelism) with
+  all_to_all dispatch/combine.
+
+Parameter placement uses ``NamedSharding`` of the GLOBAL pytree — XLA
+slices each leaf onto its shards; inside ``shard_map`` the same leaf names
+arrive as local slices and the forward in omldm_tpu.models.transformer is
+shape-polymorphic over them. shard_map's varying-axis tracking makes
+``jax.grad`` insert the correct gradient psums for replicated leaves.
+
+No counterpart exists in the reference (SURVEY.md section 2.4: tensor /
+pipeline / sequence parallelism ABSENT there) — this is the framework's
+first-class long-context + multi-chip scope.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from omldm_tpu.models.transformer import (
+    AxisSpec,
+    TransformerConfig,
+    classify_loss,
+    init_transformer,
+    lm_loss,
+)
+
+
+def make_seq_mesh(dp: int = 1, sp: int = 1, tp: int = 1,
+                  devices=None) -> Mesh:
+    """("dp", "sp", "tp") mesh over dp*sp*tp devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * sp * tp
+    if need > len(devices):
+        raise ValueError(f"mesh ({dp}x{sp}x{tp}) needs {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(grid, ("dp", "sp", "tp"))
+
+
+def _param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec tree mirroring init_transformer's pytree."""
+    rep = P()
+    layer_spec = {
+        "ln1": {"g": rep},
+        "ln2": {"g": rep},
+        "wqkv": P(None, None, "tp"),   # heads over tp
+        "wo": P("tp", None),
+    }
+    if cfg.n_experts > 0:
+        layer_spec["router"] = rep
+        layer_spec["w1"] = P("dp", None, None)   # experts over dp (= ep)
+        layer_spec["w2"] = P("dp", None, None)
+    else:
+        layer_spec["w1"] = P(None, "tp")         # Megatron column-parallel
+        layer_spec["w2"] = P("tp", None)         # Megatron row-parallel
+    return {
+        "embed": rep,
+        "pos": rep,
+        "ln_f": {"g": rep},
+        "layers": [dict(layer_spec) for _ in range(cfg.n_layers)],
+        "head": rep,
+    }
+
+
+class SeqTrainer:
+    """Adam-trained transformer over a ("dp", "sp", "tp") mesh.
+
+    Batches arrive as GLOBAL host arrays ``tokens/targets/mask: [B, L]``
+    (targets/mask pre-shifted for "lm"; ``labels: [B]`` for "classify");
+    they are split over (dp, sp) by the step's in_specs."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        mesh: Optional[Mesh] = None,
+        lr: float = 1e-3,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_seq_mesh()
+        dp, sp, tp = (self.mesh.shape[a] for a in ("dp", "sp", "tp"))
+        if cfg.n_heads % tp:
+            raise ValueError(f"n_heads {cfg.n_heads} not divisible by tp {tp}")
+        if cfg.n_experts == 0 and cfg.d_ff % tp:
+            raise ValueError(f"d_ff {cfg.d_ff} not divisible by tp {tp}")
+        if cfg.n_experts > 0 and cfg.n_experts % dp:
+            raise ValueError(f"n_experts {cfg.n_experts} not divisible by dp {dp}")
+        # always name the axes: collectives over size-1 axes compile to
+        # no-ops, and the vma typing then works uniformly on any mesh shape
+        self.axes = AxisSpec(
+            dp="dp", sp="sp", tp="tp",
+            ep="dp" if cfg.n_experts > 0 else None,
+        )
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+
+        pspecs = _param_specs(cfg)
+        params_global = init_transformer(cfg, jax.random.PRNGKey(seed))
+        self.params = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(self.mesh, spec)),
+            params_global, pspecs,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray),
+        )
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self.opt = {
+            "mu": zeros,
+            "nu": jax.tree_util.tree_map(jnp.zeros_like, self.params),
+            "count": jax.device_put(
+                jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
+            ),
+        }
+        self._pspecs = pspecs
+        ospecs = {"mu": pspecs, "nu": pspecs, "count": P()}
+        # tokens/mask are [B, L] and sequence-sharded for BOTH objectives —
+        # classify pools with pmean over sp, so its tokens must be real
+        # chunks, not replicas (replicated copies would double-count keys in
+        # ring attention and misapply position offsets)
+        data_spec = P("dp", "sp")
+        label_spec = P("dp", "sp") if cfg.objective == "lm" else P("dp")
+
+        # check_vma=True (default): shard_map tracks which mesh axes every
+        # intermediate varies over, so jax.grad's transpose inserts the
+        # gradient psums for replicated parameter leaves automatically —
+        # the manual alternative double-counts shared paths under tp.
+        step = jax.shard_map(
+            self._step_impl,
+            mesh=self.mesh,
+            in_specs=(pspecs, ospecs, data_spec, label_spec, data_spec),
+            out_specs=(pspecs, ospecs, P()),
+        )
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._fitted = 0
+
+    # --- the per-shard step ---
+
+    def _loss(self, params, tokens, targets, mask):
+        if self.cfg.objective == "lm":
+            return lm_loss(self.cfg, params, tokens, targets, mask, self.axes)
+        return classify_loss(self.cfg, params, tokens, targets, self.axes)
+
+    def _step_impl(self, params, opt, tokens, targets, mask):
+        loss, grads = jax.value_and_grad(self._loss)(params, tokens, targets, mask)
+        count = opt["count"] + 1
+        c = count.astype(jnp.float32)
+        b1, b2 = self.b1, self.b2
+
+        def adam(p, g, m, v):
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            mhat = m / (1.0 - b1**c)
+            vhat = v / (1.0 - b2**c)
+            return p - self.lr * mhat / (jnp.sqrt(vhat) + self.eps), m, v
+
+        out = jax.tree_util.tree_map(adam, params, grads, opt["mu"], opt["nu"])
+        new_params = jax.tree_util.tree_map(lambda t: t[0], out,
+                                            is_leaf=lambda x: isinstance(x, tuple))
+        new_mu = jax.tree_util.tree_map(lambda t: t[1], out,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+        new_nu = jax.tree_util.tree_map(lambda t: t[2], out,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, {"mu": new_mu, "nu": new_nu, "count": count}, loss
+
+    # --- public API ---
+
+    def step(self, tokens, targets, mask=None) -> jnp.ndarray:
+        """One global training step; returns the (lazy) global mean loss."""
+        if mask is None:
+            mask = np.ones(np.shape(tokens), np.float32)
+        self.params, self.opt, loss = self._step(
+            self.params, self.opt, tokens, targets, mask
+        )
+        self._fitted += int(np.asarray(mask).sum())
+        return loss
+
+    @property
+    def fitted(self) -> int:
+        return self._fitted
+
+    def host_params(self):
+        """Global (unsharded) parameter pytree on host."""
+        return jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), self.params
+        )

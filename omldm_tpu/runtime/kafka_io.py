@@ -38,6 +38,19 @@ DEFAULT_OUT_TOPICS = {
 }
 
 
+def _record_to_event(
+    record: Any, topic_map: Mapping[str, str]
+) -> Optional[Tuple[str, str]]:
+    """ConsumerRecord -> (stream, payload), or None for unknown topics."""
+    stream = topic_map.get(record.topic)
+    if stream is None:
+        return None
+    value = record.value
+    if isinstance(value, bytes):
+        value = value.decode("utf-8", errors="replace")
+    return (stream, value)
+
+
 def consumer_events(
     consumer: Any,
     topic_map: Optional[Mapping[str, str]] = None,
@@ -49,13 +62,9 @@ def consumer_events(
     skipped."""
     topic_map = dict(topic_map or DEFAULT_TOPICS)
     for record in consumer:
-        stream = topic_map.get(record.topic)
-        if stream is None:
-            continue
-        value = record.value
-        if isinstance(value, bytes):
-            value = value.decode("utf-8", errors="replace")
-        yield (stream, value)
+        event = _record_to_event(record, topic_map)
+        if event is not None:
+            yield event
 
 
 def polling_events(
@@ -77,13 +86,9 @@ def polling_events(
         except StopIteration:
             yield None
             continue
-        stream = topic_map.get(record.topic)
-        if stream is None:
-            continue
-        value = record.value
-        if isinstance(value, bytes):
-            value = value.decode("utf-8", errors="replace")
-        yield (stream, value)
+        event = _record_to_event(record, topic_map)
+        if event is not None:
+            yield event
 
 
 class ProducerSinks:

@@ -1,0 +1,147 @@
+"""Transformer family: forward shapes, training, and sharded-equivalence.
+
+The sharded-equivalence tests are the load-bearing ones: a SeqTrainer step
+over a real (dp, sp, tp) mesh must match the single-device step bit-for-bit
+(up to fp tolerance) — this pins down ring attention, the Megatron psums,
+the MoE all_to_all dispatch, and the gradient psums inserted by shard_map's
+varying-axis tracking, all at once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from omldm_tpu.models.transformer import (
+    AxisSpec,
+    TransformerConfig,
+    init_transformer,
+    lm_loss,
+    transformer_forward,
+)
+from omldm_tpu.parallel.seq_trainer import SeqTrainer, make_seq_mesh
+
+CFG = TransformerConfig(
+    vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+    max_len=64, objective="lm",
+)
+
+
+def _copy_batch(rng, b, l, vocab):
+    """Repeating-pattern sequences: next token is predictable."""
+    base = rng.randint(1, vocab, size=(b, 4))
+    toks = np.tile(base, (1, l // 4 + 1))[:, : l + 1]
+    return (
+        toks[:, :-1].astype(np.int32),
+        toks[:, 1:].astype(np.int32),
+        np.ones((b, l), np.float32),
+    )
+
+
+def test_forward_shapes():
+    params = init_transformer(CFG, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = transformer_forward(CFG, params, tokens)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+
+    ccfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=64, objective="classify", n_classes=3, causal=False,
+    )
+    cparams = init_transformer(ccfg, jax.random.PRNGKey(0))
+    out = transformer_forward(ccfg, cparams, tokens)
+    assert out.shape == (2, 3)
+
+
+def test_moe_forward_matches_shapes_and_is_finite():
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=64, n_experts=4,
+    )
+    params = init_transformer(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = transformer_forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, 32)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_single_device_training_learns_copy_task():
+    rng = np.random.RandomState(0)
+    trainer = SeqTrainer(CFG, mesh=make_seq_mesh(1, 1, 1), lr=3e-3, seed=1)
+    tokens, targets, mask = _copy_batch(rng, 8, 16, CFG.vocab_size)
+    first = float(np.asarray(trainer.step(tokens, targets, mask)))
+    for _ in range(60):
+        loss = trainer.step(tokens, targets, mask)
+    assert float(np.asarray(loss)) < first * 0.5
+    assert trainer.fitted == 61 * 8 * 16
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (1, 4, 2), (4, 1, 2), (2, 4, 1)])
+def test_sharded_step_matches_single_device(dp, sp, tp):
+    rng = np.random.RandomState(1)
+    tokens, targets, mask = _copy_batch(rng, 4, 16, CFG.vocab_size)
+
+    ref = SeqTrainer(CFG, mesh=make_seq_mesh(1, 1, 1), lr=1e-2, seed=3)
+    shr = SeqTrainer(CFG, mesh=make_seq_mesh(dp, sp, tp), lr=1e-2, seed=3)
+    for _ in range(3):
+        l_ref = ref.step(tokens, targets, mask)
+        l_shr = shr.step(tokens, targets, mask)
+    np.testing.assert_allclose(
+        float(np.asarray(l_ref)), float(np.asarray(l_shr)), atol=1e-4
+    )
+    p_ref, p_shr = ref.host_params(), shr.host_params()
+    flat_ref = jax.tree_util.tree_leaves(p_ref)
+    flat_shr = jax.tree_util.tree_leaves(p_shr)
+    for a, b in zip(flat_ref, flat_shr):
+        np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_moe_expert_parallel_matches_dense_dispatch():
+    """EP all_to_all routing == single-device dense dispatch when capacity
+    is ample (no token drops)."""
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=64, n_experts=4, capacity_factor=4.0,
+    )
+    rng = np.random.RandomState(2)
+    tokens, targets, mask = _copy_batch(rng, 4, 16, cfg.vocab_size)
+    ref = SeqTrainer(cfg, mesh=make_seq_mesh(1, 1, 1), lr=1e-2, seed=5)
+    shr = SeqTrainer(cfg, mesh=make_seq_mesh(4, 2, 1), lr=1e-2, seed=5)
+    for _ in range(2):
+        l_ref = ref.step(tokens, targets, mask)
+        l_shr = shr.step(tokens, targets, mask)
+    np.testing.assert_allclose(
+        float(np.asarray(l_ref)), float(np.asarray(l_shr)), atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(2, 1, 2), (2, 2, 1), (1, 4, 2)])
+def test_classify_objective_sharded(dp, sp, tp):
+    """classify must sequence-shard its tokens too — replicating them over
+    sp would double-count keys in ring attention."""
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_len=64, objective="classify", n_classes=2, causal=False,
+    )
+    rng = np.random.RandomState(3)
+    tokens = rng.randint(0, 32, size=(8, 16)).astype(np.int32)
+    labels = (tokens.sum(axis=1) % 2).astype(np.int32)
+    ref = SeqTrainer(cfg, mesh=make_seq_mesh(1, 1, 1), lr=1e-2, seed=7)
+    shr = SeqTrainer(cfg, mesh=make_seq_mesh(dp, sp, tp), lr=1e-2, seed=7)
+    for _ in range(3):
+        l_ref = ref.step(tokens, labels)
+        l_shr = shr.step(tokens, labels)
+    np.testing.assert_allclose(
+        float(np.asarray(l_ref)), float(np.asarray(l_shr)), atol=1e-4
+    )
+
+
+def test_lm_loss_perfect_prediction_near_zero():
+    """Sanity: a model that always predicts the right token has ~0 loss —
+    checked by training until the copy task is nearly solved."""
+    rng = np.random.RandomState(4)
+    trainer = SeqTrainer(CFG, mesh=make_seq_mesh(1, 1, 1), lr=5e-3, seed=9)
+    tokens, targets, mask = _copy_batch(rng, 8, 16, CFG.vocab_size)
+    for _ in range(200):
+        loss = trainer.step(tokens, targets, mask)
+    assert float(np.asarray(loss)) < 0.5
