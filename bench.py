@@ -43,27 +43,28 @@ def main() -> None:
     # batches are pre-staged on device (double-buffered prefetch): in this
     # environment the chip sits behind a network tunnel whose host->device
     # bandwidth would otherwise measure the tunnel, not the framework
-    stage = []
-    for _ in range(n_stage):
-        x = rng.randn(batch, dim).astype(np.float32)
-        y = (x @ w > 0).astype(np.float32)
-        stage.append(
-            (jax.device_put(x), jax.device_put(y), np.ones(batch, np.float32))
-        )
+    xs = rng.randn(n_stage, batch, dim).astype(np.float32)
+    ys = (xs @ w > 0).astype(np.float32)
+    masks = np.ones((n_stage, batch), np.float32)
+    counts = masks.sum(axis=1)
+    xs_d, ys_d, masks_d = (jax.device_put(a) for a in (xs, ys, masks))
 
+    # fit_many: the T staged micro-batches train as ONE lax.scan program —
+    # the device never waits on host dispatch between steps (the same chained
+    # path the protocol workers use to drain a training backlog,
+    # WorkerNode.drain_blocked)
     # warmup / compile
-    for i in range(3):
-        pipe.fit(*stage[i])
+    pipe.fit_many(xs_d, ys_d, masks_d, valid_counts=counts)
     jax.block_until_ready(pipe.state["params"])
 
-    steps = 200
+    rounds = 20
     t0 = time.perf_counter()
-    for i in range(steps):
-        pipe.fit(*stage[i % n_stage])
+    for _ in range(rounds):
+        pipe.fit_many(xs_d, ys_d, masks_d, valid_counts=counts)
     jax.block_until_ready(pipe.state["params"])
     dt = time.perf_counter() - t0
 
-    examples_per_sec = steps * batch / dt
+    examples_per_sec = rounds * n_stage * batch / dt
     print(
         json.dumps(
             {

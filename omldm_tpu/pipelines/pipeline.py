@@ -75,10 +75,12 @@ class MLPipeline:
             self._fit = self._fit_impl
             self._predict = self._predict_impl
             self._evaluate = self._evaluate_impl
+            self._fit_many = None
         else:
             self._fit = jax.jit(self._fit_impl, donate_argnums=0)
             self._predict = jax.jit(self._predict_impl)
             self._evaluate = jax.jit(self._evaluate_impl)
+            self._fit_many = jax.jit(self._fit_many_impl, donate_argnums=0)
 
     # --- fused step implementations ---
 
@@ -107,6 +109,20 @@ class MLPipeline:
         }
         return new_state, loss
 
+    def _fit_many_impl(self, state, xs, ys, masks):
+        """T chained training steps in one XLA program (lax.scan over staged
+        micro-batches) — the device never waits on host dispatch between
+        steps. Replaces T per-batch JVM fit calls of the reference's hot
+        loop (MLPipeline.pipePoint, hs_err_pid77107.log:111) with one
+        program launch per T batches."""
+
+        def step(st, batch):
+            x, y, m = batch
+            st, loss = self._fit_impl(st, x, y, m)
+            return st, loss
+
+        return jax.lax.scan(step, state, (xs, ys, masks))
+
     def _predict_impl(self, state, x):
         return self.learner.predict(state["params"], self._transform(state["preps"], x))
 
@@ -130,6 +146,36 @@ class MLPipeline:
         self._curve.append((loss, self._fitted_host))
         return loss
 
+    def fit_many(self, xs, ys, masks, valid_counts=None) -> Any:
+        """Train on T staged micro-batches with ONE program launch.
+
+        ``xs: [T, B, D]``, ``ys/masks: [T, B]``. Returns the lazy [T]
+        per-batch mean losses; the learning curve gets one point per batch,
+        same as T ``fit`` calls. Host-side learners fall back to a Python
+        loop. Pass ``valid_counts`` (per-batch valid-row counts) when
+        ``masks`` is already device-resident — otherwise the counting
+        ``np.asarray(masks)`` forces a device->host copy."""
+        masks_np = None if valid_counts is not None else np.asarray(masks)
+        if self._fit_many is None:
+            if masks_np is None:
+                masks_np = np.asarray(masks)
+            losses = [self.fit(x, y, m) for x, y, m in zip(xs, ys, masks_np)]
+            return jnp.stack([jnp.asarray(l) for l in losses])
+        self.state, losses = self._fit_many(self.state, xs, ys, masks)
+        # one curve entry holding the whole lazy [T] loss array — slicing
+        # per batch here would dispatch T tiny device ops on the hot path;
+        # curve_slice() unpacks it at stats-poll time instead
+        counts = (
+            valid_counts if valid_counts is not None
+            else masks_np.sum(axis=tuple(range(1, masks_np.ndim)))
+        )
+        fitted_after = []
+        for c in counts:
+            self._fitted_host += int(c)
+            fitted_after.append(self._fitted_host)
+        self._curve.append((losses, fitted_after))
+        return losses
+
     def predict(self, x) -> jnp.ndarray:
         return self._predict(self.state, x)
 
@@ -149,11 +195,20 @@ class MLPipeline:
     def curve_slice(self) -> List[Tuple[float, int]]:
         """Drain the learning-curve points accumulated since the last call —
         the incremental-slice semantics of FlinkHub.scala:101-116. This is
-        the only point where lazy device scalars are materialized."""
+        the only point where lazy device scalars are materialized. Entries
+        from ``fit`` hold one scalar; entries from ``fit_many`` hold a [T]
+        loss array paired with the T fitted counts."""
         fresh = self._curve
         self._curve = []
-        self._curve_emitted += len(fresh)
-        return [(float(l), int(f)) for l, f in fresh]
+        out: List[Tuple[float, int]] = []
+        for losses, fitted in fresh:
+            if isinstance(fitted, list):
+                arr = np.asarray(losses).reshape(-1)
+                out.extend((float(l), int(f)) for l, f in zip(arr, fitted))
+            else:
+                out.append((float(losses), int(fitted)))
+        self._curve_emitted += len(out)
+        return out
 
     def get_flat_params(self) -> Tuple[np.ndarray, Any]:
         """Flatten learner params to one vector (for bucketed query responses

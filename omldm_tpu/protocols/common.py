@@ -100,9 +100,26 @@ class SyncingWorker(WorkerNode):
         return loss
 
     def drain_blocked(self) -> None:
+        """Train the backlog accumulated while waiting on the PS. Batches up
+        to the next sync point are chained into ONE device launch
+        (MLPipeline.fit_many lax.scan) instead of per-batch dispatch — the
+        backlog-recovery fast path."""
         while self._blocked and not self.waiting:
-            x, y, mask = self._blocked.pop(0)
-            self.on_training_batch(x, y, mask)
+            until_sync = self.sync_every - (self._batches % self.sync_every)
+            n = min(until_sync, len(self._blocked))
+            chunk = self._blocked[:n]
+            del self._blocked[:n]
+            if n == 1:
+                self.pipeline.fit(*chunk[0])
+            else:
+                self.pipeline.fit_many(
+                    np.stack([c[0] for c in chunk]),
+                    np.stack([c[1] for c in chunk]),
+                    np.stack([c[2] for c in chunk]),
+                )
+            self._batches += n
+            if self._batches % self.sync_every == 0:
+                self.on_sync_point()
 
     def on_sync_point(self) -> None:
         """Called every ``syncEvery`` batches; protocol-specific."""

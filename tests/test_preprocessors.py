@@ -119,6 +119,41 @@ class TestMLPipeline:
         _, score = pipe.evaluate(jnp.asarray(x), jnp.asarray(y), jnp.ones(4096))
         assert score > 0.9
 
+    def test_fit_many_matches_sequential_fits(self):
+        """One lax.scan launch over T staged batches == T fit calls: same
+        params, same fitted count, same learning-curve points."""
+        rng = np.random.RandomState(1)
+        xs = rng.randn(6, 32, 4).astype(np.float32)
+        ys = (xs.sum(-1) > 0).astype(np.float32) * 2 - 1
+        masks = np.ones((6, 32), np.float32)
+
+        seq = MLPipeline(
+            LearnerSpec("Softmax", hyper_parameters={"learningRate": 0.1, "nClasses": 2}),
+            [PreprocessorSpec("StandardScaler")],
+            dim=4,
+        )
+        many = MLPipeline(
+            LearnerSpec("Softmax", hyper_parameters={"learningRate": 0.1, "nClasses": 2}),
+            [PreprocessorSpec("StandardScaler")],
+            dim=4,
+        )
+        for i in range(6):
+            seq.fit(jnp.asarray(xs[i]), jnp.asarray(ys[i]), masks[i])
+        losses = many.fit_many(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(masks))
+        assert many.fitted == seq.fitted == 6 * 32
+        np.testing.assert_allclose(
+            np.asarray(jax.flatten_util.ravel_pytree(many.state["params"])[0]),
+            np.asarray(jax.flatten_util.ravel_pytree(seq.state["params"])[0]),
+            atol=1e-5,
+        )
+        c_seq = seq.curve_slice()
+        c_many = many.curve_slice()
+        assert [f for _, f in c_seq] == [f for _, f in c_many]
+        np.testing.assert_allclose(
+            [l for l, _ in c_seq], [l for l, _ in c_many], atol=1e-5
+        )
+        assert losses.shape == (6,)
+
     def test_curve_slices_are_incremental(self):
         pipe = MLPipeline(LearnerSpec("PA"), dim=3)
         x = jnp.ones((8, 3))
