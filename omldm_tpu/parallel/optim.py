@@ -1,0 +1,60 @@
+"""Shared optimizer scaffolding for the sharded trainers.
+
+Both SeqTrainer (dp/sp/tp + ep) and PPTrainer (dp/pp) run hand-rolled Adam
+inside ``shard_map`` — optax state pytrees are opaque to per-leaf
+PartitionSpec placement, while this explicit ``{"mu", "nu", "count"}``
+layout shards ``mu``/``nu`` exactly like the parameters and keeps the step
+count replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_adam_state(params: Any, mesh: Mesh) -> Dict[str, Any]:
+    """Zero moments sharded like ``params`` + a replicated step count."""
+    return {
+        "mu": jax.tree_util.tree_map(jnp.zeros_like, params),
+        "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+        "count": jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        ),
+    }
+
+
+def adam_opt_specs(pspecs: Any) -> Dict[str, Any]:
+    """PartitionSpec tree for :func:`init_adam_state`'s layout."""
+    return {"mu": pspecs, "nu": pspecs, "count": P()}
+
+
+def adam_update(
+    params: Any,
+    grads: Any,
+    opt: Dict[str, Any],
+    lr: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> Tuple[Any, Dict[str, Any]]:
+    """One bias-corrected Adam step; pure, safe inside shard_map/jit."""
+    count = opt["count"] + 1
+    c = count.astype(jnp.float32)
+
+    def leaf(p, g, mu, nu):
+        mu = b1 * mu + (1.0 - b1) * g
+        nu = b2 * nu + (1.0 - b2) * g * g
+        mhat = mu / (1.0 - b1**c)
+        vhat = nu / (1.0 - b2**c)
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), mu, nu
+
+    out = jax.tree_util.tree_map(leaf, params, grads, opt["mu"], opt["nu"])
+    istup = lambda x: isinstance(x, tuple)  # noqa: E731
+    new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=istup)
+    new_mu = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=istup)
+    new_nu = jax.tree_util.tree_map(lambda t: t[2], out, is_leaf=istup)
+    return new_params, {"mu": new_mu, "nu": new_nu, "count": count}

@@ -39,6 +39,7 @@ from omldm_tpu.models.transformer import (
     init_transformer,
     lm_loss,
 )
+from omldm_tpu.parallel.optim import adam_opt_specs, adam_update, init_adam_state
 
 
 def make_seq_mesh(dp: int = 1, sp: int = 1, tp: int = 1,
@@ -118,16 +119,9 @@ class SeqTrainer:
             params_global, pspecs,
             is_leaf=lambda x: isinstance(x, jnp.ndarray),
         )
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, self.params)
-        self.opt = {
-            "mu": zeros,
-            "nu": jax.tree_util.tree_map(jnp.zeros_like, self.params),
-            "count": jax.device_put(
-                jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
-            ),
-        }
+        self.opt = init_adam_state(self.params, self.mesh)
         self._pspecs = pspecs
-        ospecs = {"mu": pspecs, "nu": pspecs, "count": P()}
+        ospecs = adam_opt_specs(pspecs)
         # tokens/mask are [B, L] and sequence-sharded for BOTH objectives —
         # classify pools with pmean over sp, so its tokens must be real
         # chunks, not replicas (replicated copies would double-count keys in
@@ -157,25 +151,10 @@ class SeqTrainer:
 
     def _step_impl(self, params, opt, tokens, targets, mask):
         loss, grads = jax.value_and_grad(self._loss)(params, tokens, targets, mask)
-        count = opt["count"] + 1
-        c = count.astype(jnp.float32)
-        b1, b2 = self.b1, self.b2
-
-        def adam(p, g, m, v):
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * v + (1.0 - b2) * g * g
-            mhat = m / (1.0 - b1**c)
-            vhat = v / (1.0 - b2**c)
-            return p - self.lr * mhat / (jnp.sqrt(vhat) + self.eps), m, v
-
-        out = jax.tree_util.tree_map(adam, params, grads, opt["mu"], opt["nu"])
-        new_params = jax.tree_util.tree_map(lambda t: t[0], out,
-                                            is_leaf=lambda x: isinstance(x, tuple))
-        new_mu = jax.tree_util.tree_map(lambda t: t[1], out,
-                                        is_leaf=lambda x: isinstance(x, tuple))
-        new_nu = jax.tree_util.tree_map(lambda t: t[2], out,
-                                        is_leaf=lambda x: isinstance(x, tuple))
-        return new_params, {"mu": new_mu, "nu": new_nu, "count": count}, loss
+        new_params, new_opt = adam_update(
+            params, grads, opt, self.lr, self.b1, self.b2, self.eps
+        )
+        return new_params, new_opt, loss
 
     # --- public API ---
 
