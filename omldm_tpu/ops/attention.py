@@ -207,6 +207,17 @@ def flash_attention_pallas(
         kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
 
+    # under shard_map's vma typing the kernel output must declare which mesh
+    # axes it varies over — inherit the query's
+    try:
+        vma = jax.typeof(qf).vma
+    except Exception:
+        vma = None
+    out_struct = (
+        jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype, vma=vma)
+        if vma
+        else jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype)
+    )
     grid = (b * h, (lq + pad_q) // block_q)
     out = pl.pallas_call(
         functools.partial(
@@ -224,7 +235,7 @@ def flash_attention_pallas(
             pl.BlockSpec((None, lk + pad_k, dh), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, dh), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype),
+        out_shape=out_struct,
         interpret=interpret,
     )(qf, kf, vf)
     out = out[:, :lq].reshape(b, h, lq, dh).transpose(0, 2, 1, 3)

@@ -31,10 +31,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from omldm_tpu.models.transformer import (
     TransformerConfig,
     _rms_norm,
+    cast_params,
     init_transformer,
 )
 from omldm_tpu.parallel.optim import adam_opt_specs, adam_update, init_adam_state
-from omldm_tpu.ops.attention import blockwise_attention
+from omldm_tpu.ops.attention import attention
 
 
 def _pvary(x, axes):
@@ -72,7 +73,8 @@ def _apply_block(cfg: TransformerConfig, layer, x):
     q = qkv[:, :, 0].reshape(b, lc, cfg.n_heads, dh)
     k = qkv[:, :, 1].reshape(b, lc, cfg.n_heads, dh)
     v = qkv[:, :, 2].reshape(b, lc, cfg.n_heads, dh)
-    o = blockwise_attention(q, k, v, causal=cfg.causal)
+    # backend dispatch: Pallas flash kernel on TPU, blockwise scan on CPU
+    o = attention(q, k, v, causal=cfg.causal)
     x = x + o.reshape(b, lc, cfg.n_heads * dh) @ layer["wo"]
     z = _rms_norm(x, layer["ln2"]["g"])
     return x + jax.nn.relu(z @ layer["w1"]) @ layer["w2"]
@@ -99,6 +101,7 @@ def pp_lm_loss(
 ) -> jnp.ndarray:
     """Global-mean LM loss of the pipelined forward. Runs INSIDE shard_map
     over a ("dp", "pp") mesh."""
+    params = cast_params(params, cfg.dtype)
     n = jax.lax.axis_size(pp_axis)
     i = jax.lax.axis_index(pp_axis)
     m = tokens.shape[0]
@@ -225,9 +228,11 @@ class PPTrainer:
         )
         self._fitted = 0
 
-    def step(self, tokens, targets, mask=None) -> jnp.ndarray:
-        """tokens/targets/mask: [B, L] global host arrays; B must divide by
-        dp * n_micro. Returns the (lazy) global mean loss."""
+    def step(self, tokens, targets, mask=None, valid_count=None) -> jnp.ndarray:
+        """tokens/targets/mask: [B, L] global arrays; B must divide by
+        dp * n_micro. Returns the (lazy) global mean loss. Pass
+        ``valid_count`` when ``mask`` is device-resident to avoid a
+        device->host copy for the fitted counter."""
         if mask is None:
             mask = np.ones(np.shape(tokens), np.float32)
         b, l = np.shape(tokens)
@@ -237,14 +242,20 @@ class PPTrainer:
             raise ValueError(f"batch {b} not divisible by n_micro*dp {m * dp}")
 
         def to_micro(a):
-            # [B, L] -> [M, B/M, L] with dp-contiguous rows per microbatch
+            # [B, L] -> [M, B/M, L] with dp-contiguous rows per microbatch;
+            # device arrays reshape lazily on device (no host round trip)
+            if isinstance(a, jnp.ndarray):
+                return a.reshape(m, b // m, l)
             return np.asarray(a).reshape(m, b // m, l)
 
         self.params, self.opt, loss = self._step(
             self.params, self.opt,
             to_micro(tokens), to_micro(targets), to_micro(mask),
         )
-        self._fitted += int(np.asarray(mask).sum())
+        self._fitted += (
+            int(valid_count) if valid_count is not None
+            else int(np.asarray(mask).sum())
+        )
         return loss
 
     @property

@@ -40,6 +40,7 @@ from omldm_tpu.models.transformer import (
     lm_loss,
 )
 from omldm_tpu.parallel.optim import adam_opt_specs, adam_update, init_adam_state
+from omldm_tpu.utils import batch_valid_counts
 
 
 def make_seq_mesh(dp: int = 1, sp: int = 1, tp: int = 1,
@@ -140,6 +141,10 @@ class SeqTrainer:
             out_specs=(pspecs, ospecs, P()),
         )
         self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._ospecs = ospecs
+        self._data_spec = data_spec
+        self._label_spec = label_spec
+        self._step_many = None  # built lazily on first step_many call
         self._fitted = 0
 
     # --- the per-shard step ---
@@ -158,15 +163,63 @@ class SeqTrainer:
 
     # --- public API ---
 
-    def step(self, tokens, targets, mask=None) -> jnp.ndarray:
-        """One global training step; returns the (lazy) global mean loss."""
+    def step(self, tokens, targets, mask=None, valid_count=None) -> jnp.ndarray:
+        """One global training step; returns the (lazy) global mean loss.
+        Pass ``valid_count`` when ``mask`` is device-resident to avoid a
+        device->host copy for the fitted counter."""
         if mask is None:
             mask = np.ones(np.shape(tokens), np.float32)
+            valid_count = int(mask.sum()) if valid_count is None else valid_count
         self.params, self.opt, loss = self._step(
             self.params, self.opt, tokens, targets, mask
         )
-        self._fitted += int(np.asarray(mask).sum())
+        self._fitted += (
+            int(valid_count) if valid_count is not None
+            else int(np.asarray(mask).sum())
+        )
         return loss
+
+    def step_many(self, tokens_s, targets_s, masks_s=None, valid_counts=None):
+        """T chained global steps in ONE program launch (lax.scan carrying
+        (params, opt) over staged batches — the device never waits on the
+        host between steps). tokens_s/targets_s/masks_s have a leading [T]
+        dim; returns the lazy [T] losses."""
+        if masks_s is None:
+            masks_s = np.ones(np.shape(tokens_s), np.float32)
+        if self._step_many is None:
+            lead = lambda s: P(*((None,) + tuple(s)))  # noqa: E731
+
+            def many_impl(params, opt, ts, gs, ms):
+                def body(carry, b):
+                    p, o = carry
+                    tok, tgt, m = b
+                    p, o, loss = self._step_impl(p, o, tok, tgt, m)
+                    return (p, o), loss
+
+                (params, opt), losses = jax.lax.scan(
+                    body, (params, opt), (ts, gs, ms)
+                )
+                return params, opt, losses
+
+            self._step_many = jax.jit(
+                jax.shard_map(
+                    many_impl,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._pspecs, self._ospecs,
+                        lead(self._data_spec), lead(self._label_spec),
+                        lead(self._data_spec),
+                    ),
+                    out_specs=(self._pspecs, self._ospecs, P()),
+                ),
+                donate_argnums=(0, 1),
+            )
+        counts = batch_valid_counts(masks_s, valid_counts)
+        self.params, self.opt, losses = self._step_many(
+            self.params, self.opt, tokens_s, targets_s, masks_s
+        )
+        self._fitted += sum(counts)
+        return losses
 
     @property
     def fitted(self) -> int:

@@ -52,6 +52,7 @@ from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec, TrainingConfig
 from omldm_tpu.learners.registry import make_learner
 from omldm_tpu.preprocessors.registry import make_preprocessor
 from omldm_tpu.parallel.mesh import make_mesh
+from omldm_tpu.utils import batch_valid_counts
 
 
 def _pvary(x, axes):
@@ -146,6 +147,8 @@ class SPMDTrainer:
         )
 
         step_impl = self._build_step()
+        self._step_fn = step_impl
+        self._step_many = None  # built lazily on first step_many call
         batch_spec = P("dp")
         self._step = jax.jit(
             jax.shard_map(
@@ -345,15 +348,50 @@ class SPMDTrainer:
 
     # --- public API ---
 
-    def step(self, x, y, mask):
-        """One fleet step. x: [dp, B, D]; y, mask: [dp, B] (host arrays).
-        Returns the lazy [dp, hub] loss array."""
-        n = int(np.asarray(mask).sum())
+    def step(self, x, y, mask, valid_count=None):
+        """One fleet step. x: [dp, B, D]; y, mask: [dp, B].
+        Returns the lazy [dp, hub] loss array. Pass ``valid_count`` (total
+        valid rows) when ``mask`` is device-resident — otherwise the
+        counting ``np.asarray(mask)`` forces a device->host copy."""
+        n = int(valid_count) if valid_count is not None else int(np.asarray(mask).sum())
         self.state, loss = self._step(self.state, x, y, mask)
         self._fitted_host += n
         self._steps_host += 1
         self._curve.append((loss, self._fitted_host))
         return loss
+
+    def step_many(self, xs, ys, masks, valid_counts=None):
+        """T chained fleet steps in ONE program launch (lax.scan over staged
+        batches inside the sharded step). xs: [T, dp, B, D]; ys/masks:
+        [T, dp, B]. Returns the lazy [T, dp, hub] losses."""
+        if self._step_many is None:
+            batch_spec = P(None, "dp")
+
+            def many_impl(state, xs, ys, masks):
+                def body(st, b):
+                    x, y, m = b
+                    return self._step_fn(st, x, y, m)
+
+                return jax.lax.scan(body, state, (xs, ys, masks))
+
+            self._step_many = jax.jit(
+                jax.shard_map(
+                    many_impl,
+                    mesh=self.mesh,
+                    in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
+                    out_specs=(self._state_specs, P(None, "dp", "hub")),
+                ),
+                donate_argnums=0,
+            )
+        counts = batch_valid_counts(masks, valid_counts)
+        self.state, losses = self._step_many(self.state, xs, ys, masks)
+        fitted_after = []
+        for c in counts:
+            self._fitted_host += c
+            fitted_after.append(self._fitted_host)
+        self._steps_host += len(counts)
+        self._curve.append((losses, fitted_after))
+        return losses
 
     @property
     def fitted(self) -> int:
@@ -362,7 +400,15 @@ class SPMDTrainer:
     def curve_slice(self) -> List[Tuple[float, int]]:
         fresh = self._curve
         self._curve = []
-        return [(float(np.asarray(l).mean()), f) for l, f in fresh]
+        out: List[Tuple[float, int]] = []
+        for losses, fitted in fresh:
+            if isinstance(fitted, list):  # step_many entry: [T, dp, hub]
+                arr = np.asarray(losses)
+                arr = arr.reshape(arr.shape[0], -1).mean(axis=1)
+                out.extend((float(l), int(f)) for l, f in zip(arr, fitted))
+            else:
+                out.append((float(np.asarray(losses).mean()), int(fitted)))
+        return out
 
     def sync_count(self) -> int:
         """Total parameter synchronizations executed (summed over workers for

@@ -26,6 +26,7 @@ from omldm_tpu.learners.base import Learner
 from omldm_tpu.learners.registry import make_learner
 from omldm_tpu.preprocessors.base import Preprocessor
 from omldm_tpu.preprocessors.registry import make_preprocessor
+from omldm_tpu.utils import batch_valid_counts
 
 
 class MLPipeline:
@@ -155,23 +156,17 @@ class MLPipeline:
         loop. Pass ``valid_counts`` (per-batch valid-row counts) when
         ``masks`` is already device-resident — otherwise the counting
         ``np.asarray(masks)`` forces a device->host copy."""
-        masks_np = None if valid_counts is not None else np.asarray(masks)
         if self._fit_many is None:
-            if masks_np is None:
-                masks_np = np.asarray(masks)
+            masks_np = np.asarray(masks)
             losses = [self.fit(x, y, m) for x, y, m in zip(xs, ys, masks_np)]
             return jnp.stack([jnp.asarray(l) for l in losses])
         self.state, losses = self._fit_many(self.state, xs, ys, masks)
         # one curve entry holding the whole lazy [T] loss array — slicing
         # per batch here would dispatch T tiny device ops on the hot path;
         # curve_slice() unpacks it at stats-poll time instead
-        counts = (
-            valid_counts if valid_counts is not None
-            else masks_np.sum(axis=tuple(range(1, masks_np.ndim)))
-        )
         fitted_after = []
-        for c in counts:
-            self._fitted_host += int(c)
+        for c in batch_valid_counts(masks, valid_counts):
+            self._fitted_host += c
             fitted_after.append(self._fitted_host)
         self._curve.append((losses, fitted_after))
         return losses

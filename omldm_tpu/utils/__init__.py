@@ -1,0 +1,5 @@
+"""Small shared utilities."""
+
+from omldm_tpu.utils.counting import batch_valid_counts
+
+__all__ = ["batch_valid_counts"]

@@ -52,6 +52,41 @@ class TestSPMDProtocols:
         assert score > 0.85, f"{protocol}: score={score}"
         assert trainer.fitted == 8 * 64 * 40
 
+    @pytest.mark.parametrize("protocol", ["Synchronous", "GM", "Asynchronous"])
+    def test_step_many_matches_sequential_steps(self, protocol):
+        """One scanned launch over T stacked batches == T step() calls:
+        same final params, fitted count, sync count, and curve watermarks."""
+        mesh = make_mesh(dp=4, hub=2)
+        tc = TrainingConfiguration(
+            protocol=protocol, extra={"syncEvery": 2, "threshold": 0.1}
+        )
+
+        def build():
+            return SPMDTrainer(
+                LearnerSpec("PA", hyper_parameters={"C": 1.0}),
+                dim=6, protocol=protocol, mesh=mesh,
+                training_configuration=tc, batch_size=32,
+            )
+
+        data, _ = make_data(5, 4, 32, 6, seed=3)
+        seq = build()
+        for x, y, m in data:
+            seq.step(x, y, m)
+        many = build()
+        xs = np.stack([d[0] for d in data])
+        ys = np.stack([d[1] for d in data])
+        ms = np.stack([d[2] for d in data])
+        losses = many.step_many(xs, ys, ms)
+        assert losses.shape[0] == 5
+        assert many.fitted == seq.fitted == 5 * 4 * 32
+        assert many.sync_count() == seq.sync_count()
+        np.testing.assert_allclose(
+            many.global_flat_params(), seq.global_flat_params(), atol=1e-5
+        )
+        assert [f for _, f in many.curve_slice()] == [
+            f for _, f in seq.curve_slice()
+        ]
+
     def test_synchronous_replicas_identical_after_sync(self):
         trainer, _, _ = run_trainer("Synchronous")
         # step 40 with syncEvery 2 => last step synced; all replicas equal
