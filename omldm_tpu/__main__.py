@@ -113,55 +113,62 @@ def build_job(flags: Dict[str, str]) -> Tuple[StreamJob, List[_FileSink]]:
 def main(argv: Optional[List[str]] = None) -> int:
     flags = parse_flags(sys.argv[1:] if argv is None else argv)
     job, sinks = build_job(flags)
-    try:
-        if "kafkaBrokers" in flags:
-            from omldm_tpu.runtime.kafka_io import connect_kafka
+    from omldm_tpu.utils import trace
 
-            events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
-            # Kafka producers are the default egress; an explicitly-passed
-            # file sink keeps precedence over the producer for its stream
-            job.set_sinks(
-                on_prediction=(
-                    None if "predictionsOut" in flags
-                    else producer_sinks.on_prediction
-                ),
-                on_response=(
-                    None if "responsesOut" in flags
-                    else producer_sinks.on_response
-                ),
-                on_performance=(
-                    None if "performanceOut" in flags
-                    else producer_sinks.on_performance
-                ),
-            )
-            # start the silence clock at loop entry so a broker that never
-            # delivers anything still terminates after the timeout
-            job.stats.mark_activity()
-            for event in events:  # yields None on each idle poll window
-                if event is not None:
-                    job.process_event(*event)
-                    if job.checkpoint_manager is not None:
-                        job.checkpoint_manager.maybe_save(job)
-                if job.check_silence() is not None:
-                    break
-        elif "events" in flags:
-            job.run(combined_events(flags["events"]))
-        else:
-            sources = [
-                file_events(flags[topic], topic)
-                for topic in _STREAMS
-                if topic in flags
-            ]
-            if not sources:
-                raise SystemExit(
-                    "no sources: pass --trainingData/--forecastingData/"
-                    "--requests <path.jsonl>, --events <combined.jsonl>, "
-                    "or --kafkaBrokers <host:port>"
-                )
-            job.run(interleave(*sources))
+    try:
+        with trace(flags.get("profileDir")):
+            return _run(job, flags)
     finally:
         for sink in sinks:
             sink.close()
+
+
+def _run(job: StreamJob, flags: Dict[str, str]) -> int:
+    if "kafkaBrokers" in flags:
+        from omldm_tpu.runtime.kafka_io import connect_kafka
+
+        events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
+        # Kafka producers are the default egress; an explicitly-passed
+        # file sink keeps precedence over the producer for its stream
+        job.set_sinks(
+            on_prediction=(
+                None if "predictionsOut" in flags
+                else producer_sinks.on_prediction
+            ),
+            on_response=(
+                None if "responsesOut" in flags
+                else producer_sinks.on_response
+            ),
+            on_performance=(
+                None if "performanceOut" in flags
+                else producer_sinks.on_performance
+            ),
+        )
+        # start the silence clock at loop entry so a broker that never
+        # delivers anything still terminates after the timeout
+        job.stats.mark_activity()
+        for event in events:  # yields None on each idle poll window
+            if event is not None:
+                job.process_event(*event)
+                if job.checkpoint_manager is not None:
+                    job.checkpoint_manager.maybe_save(job)
+            if job.check_silence() is not None:
+                break
+    elif "events" in flags:
+        job.run(combined_events(flags["events"]))
+    else:
+        sources = [
+            file_events(flags[topic], topic)
+            for topic in _STREAMS
+            if topic in flags
+        ]
+        if not sources:
+            raise SystemExit(
+                "no sources: pass --trainingData/--forecastingData/"
+                "--requests <path.jsonl>, --events <combined.jsonl>, "
+                "or --kafkaBrokers <host:port>"
+            )
+        job.run(interleave(*sources))
     return 0
 
 

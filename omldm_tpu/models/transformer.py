@@ -47,6 +47,10 @@ class TransformerConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     dtype: Any = jnp.float32
+    # sequence-parallel attention strategy over the sp axis:
+    # "ring" (ppermute K/V rotation, O(L/sp) memory) or "ulysses"
+    # (all_to_all head/seq re-shard; needs (n_heads // tp) % sp == 0)
+    seq_parallel: str = "ring"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,7 +157,12 @@ def _attention_block(cfg, layer, x, axes: AxisSpec):
     k = qkv[:, :, 1].reshape(b, lc, heads_local, dh)
     v = qkv[:, :, 2].reshape(b, lc, heads_local, dh)
     if axes.sp and jax.lax.axis_size(axes.sp) > 1:
-        o = ring_attention(q, k, v, axes.sp, causal=cfg.causal)
+        if cfg.seq_parallel == "ulysses":
+            from omldm_tpu.ops.ulysses import ulysses_attention
+
+            o = ulysses_attention(q, k, v, axes.sp, causal=cfg.causal)
+        else:
+            o = ring_attention(q, k, v, axes.sp, causal=cfg.causal)
     else:
         # single sequence shard: backend dispatch — Pallas flash kernel on
         # TPU (differentiable via its blockwise-derived VJP), blockwise scan

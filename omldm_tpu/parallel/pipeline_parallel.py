@@ -208,6 +208,8 @@ class PPTrainer:
         )
         self.opt = init_adam_state(self.params, self.mesh)
         ospecs = adam_opt_specs(pspecs)
+        self._pspecs = pspecs
+        self._ospecs = ospecs
         data_spec = P(None, "dp", None)  # [M, B, L] microbatches, B over dp
 
         def step_impl(params, opt, tokens, targets, mask):
@@ -266,3 +268,15 @@ class PPTrainer:
         return jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)), self.params
         )
+
+    def save(self, directory: str) -> None:
+        """Orbax snapshot of {params, opt, fitted}."""
+        from omldm_tpu.parallel.ckpt import save_trainer_state
+
+        save_trainer_state(self, directory)
+
+    def load(self, directory: str) -> None:
+        """Restore a snapshot onto this trainer's mesh (same cfg/mesh)."""
+        from omldm_tpu.parallel.ckpt import load_trainer_state
+
+        load_trainer_state(self, directory)

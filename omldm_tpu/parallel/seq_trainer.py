@@ -105,6 +105,16 @@ class SeqTrainer:
             raise ValueError(f"d_ff {cfg.d_ff} not divisible by tp {tp}")
         if cfg.n_experts > 0 and cfg.n_experts % dp:
             raise ValueError(f"n_experts {cfg.n_experts} not divisible by dp {dp}")
+        if cfg.seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel must be 'ring' or 'ulysses', got "
+                f"{cfg.seq_parallel!r}"
+            )
+        if cfg.seq_parallel == "ulysses" and sp > 1 and (cfg.n_heads // tp) % sp:
+            raise ValueError(
+                f"ulysses needs the per-tp-shard head count "
+                f"({cfg.n_heads // tp}) divisible by sp {sp}"
+            )
         # always name the axes: collectives over size-1 axes compile to
         # no-ops, and the vma typing then works uniformly on any mesh shape
         self.axes = AxisSpec(
@@ -230,3 +240,16 @@ class SeqTrainer:
         return jax.tree_util.tree_map(
             lambda l: np.asarray(jax.device_get(l)), self.params
         )
+
+    def save(self, directory: str) -> None:
+        """Orbax snapshot of {params, opt, fitted} (SURVEY.md section 7
+        step 8 — the trainer-side checkpoint/resume path)."""
+        from omldm_tpu.parallel.ckpt import save_trainer_state
+
+        save_trainer_state(self, directory)
+
+    def load(self, directory: str) -> None:
+        """Restore a snapshot onto this trainer's mesh (same cfg/mesh)."""
+        from omldm_tpu.parallel.ckpt import load_trainer_state
+
+        load_trainer_state(self, directory)

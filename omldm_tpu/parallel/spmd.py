@@ -39,7 +39,6 @@ Protocol mapping (SURVEY.md section 7 step 5):
 
 from __future__ import annotations
 
-import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -446,24 +445,16 @@ class SPMDTrainer:
 
     def save(self, directory: str) -> None:
         """Orbax snapshot of the full fleet state (SURVEY.md section 7 step 8)."""
-        import orbax.checkpoint as ocp
+        from omldm_tpu.parallel.ckpt import save_tree
 
-        host_state = jax.tree_util.tree_map(
-            lambda l: np.asarray(jax.device_get(l)), self.state
-        )
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.abspath(directory), host_state, force=True)
+        save_tree(directory, self.state)
 
     def load(self, directory: str) -> None:
         """Restore fleet state saved by :meth:`save` (same mesh shape)."""
-        import orbax.checkpoint as ocp
+        from omldm_tpu.parallel.ckpt import load_tree, place_tree
 
-        ckptr = ocp.PyTreeCheckpointer()
-        host_state = ckptr.restore(os.path.abspath(directory))
-        spec = NamedSharding(self.mesh, P("dp", "hub"))
-        self.state = jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(jnp.asarray(leaf), spec), host_state
-        )
+        host_state = load_tree(directory)
+        self.state = place_tree(host_state, self._state_specs, self.mesh)
 
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """Loss/score of the worker-0 model on a host-side holdout set."""

@@ -1,5 +1,6 @@
 """Small shared utilities."""
 
 from omldm_tpu.utils.counting import batch_valid_counts
+from omldm_tpu.utils.tracing import StepTimer, trace
 
-__all__ = ["batch_valid_counts"]
+__all__ = ["batch_valid_counts", "StepTimer", "trace"]

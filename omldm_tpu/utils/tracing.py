@@ -1,0 +1,77 @@
+"""Tracing / profiling utilities (SURVEY.md section 5: the reference has no
+tracing at all — its only cost observability is CountableSerial byte
+accounting. The TPU build adds the two things that matter here: XLA
+profiler traces and host-side step timing percentiles.)
+
+- :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace directory (op/fusion timeline, HBM usage).
+- :class:`StepTimer` — cheap host-side wall-clock accounting for streaming
+  steps: per-step ms percentiles and steps/sec, suitable for continuous
+  emission alongside the Statistics plane's bytesShipped counters
+  (FlinkHub.scala:118-127).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """Profile the enclosed block with jax.profiler when ``log_dir`` is
+    set; no-op otherwise (so call sites can pass the flag through
+    unconditionally)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class StepTimer:
+    """Record per-step wall-clock durations and summarize percentiles."""
+
+    def __init__(self, name: str = "step"):
+        self.name = name
+        self._durations_ms: List[float] = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._durations_ms.append((time.perf_counter() - self._t0) * 1000.0)
+        self._t0 = None
+        return False
+
+    def record(self, duration_ms: float) -> None:
+        self._durations_ms.append(float(duration_ms))
+
+    @property
+    def count(self) -> int:
+        return len(self._durations_ms)
+
+    def summary(self) -> Dict[str, float]:
+        """{count, mean_ms, p50_ms, p99_ms, steps_per_sec}; zeros if empty."""
+        import numpy as np
+
+        if not self._durations_ms:
+            return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0, "p99_ms": 0.0,
+                    "steps_per_sec": 0.0}
+        d = np.asarray(self._durations_ms)
+        mean = float(d.mean())
+        return {
+            "count": int(d.size),
+            "mean_ms": mean,
+            "p50_ms": float(np.percentile(d, 50)),
+            "p99_ms": float(np.percentile(d, 99)),
+            "steps_per_sec": 1000.0 / mean if mean > 0 else 0.0,
+        }
+
+    def reset(self) -> None:
+        self._durations_ms = []

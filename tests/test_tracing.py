@@ -1,0 +1,82 @@
+"""Tracing/profiling utilities."""
+
+import json
+import os
+
+import numpy as np
+
+from omldm_tpu.utils import StepTimer, trace
+
+
+def test_step_timer_percentiles():
+    t = StepTimer("fit")
+    for ms in (1.0, 2.0, 3.0, 4.0, 100.0):
+        t.record(ms)
+    s = t.summary()
+    assert s["count"] == 5
+    assert abs(s["p50_ms"] - 3.0) < 1e-9
+    assert s["p99_ms"] > 90.0
+    assert s["steps_per_sec"] > 0
+    t.reset()
+    assert t.summary()["count"] == 0
+
+
+def test_step_timer_context_manager():
+    t = StepTimer()
+    with t:
+        pass
+    assert t.count == 1
+    assert t.summary()["mean_ms"] >= 0.0
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_trace_writes_profile(tmp_path):
+    """jax.profiler trace produces artifacts in the target dir."""
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "prof")
+    with trace(d):
+        jnp.asarray(np.ones(8)).sum().block_until_ready()
+    # the profiler lays out plugins/profile/<run>/...; any content counts
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "profiler trace produced no files"
+
+
+def test_cli_accepts_profile_dir(tmp_path):
+    """--profileDir flows through the CLI without breaking the run."""
+    from omldm_tpu.__main__ import main
+
+    events = tmp_path / "events.jsonl"
+    lines = [
+        {"stream": "requests", "data": {
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "trainingConfiguration": {"protocol": "CentralizedTraining"},
+        }},
+    ]
+    rng = np.random.RandomState(0)
+    for i in range(40):
+        x = rng.randn(4)
+        lines.append({"stream": "trainingData", "data": {
+            "id": i, "numericalFeatures": [round(float(v), 4) for v in x],
+            "target": float(x.sum() > 0),
+        }})
+    events.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+    perf = tmp_path / "perf.jsonl"
+    rc = main([
+        "--events", str(events),
+        "--parallelism", "1",
+        "--performanceOut", str(perf),
+        "--profileDir", str(tmp_path / "prof"),
+        "--timeout", "1000",
+    ])
+    assert rc == 0
+    assert perf.exists() and perf.read_text().strip()
