@@ -116,6 +116,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from omldm_tpu.utils import trace
 
     try:
+        if "kafkaBrokers" in flags:
+            # unbounded stream: the kafka loop bounds its own profile window
+            # (--profileSteps events) instead of tracing the job lifetime
+            return _run(job, flags)
         with trace(flags.get("profileDir")):
             return _run(job, flags)
     finally:
@@ -144,16 +148,39 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
                 else producer_sinks.on_performance
             ),
         )
+        # bounded profile window for the unbounded stream: trace only the
+        # first --profileSteps events (default 1000)
+        profile_dir = flags.get("profileDir")
+        profile_steps = int(flags.get("profileSteps", "1000"))
+        tracing = False
+        if profile_dir:
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
+        n_events = 0
         # start the silence clock at loop entry so a broker that never
         # delivers anything still terminates after the timeout
         job.stats.mark_activity()
-        for event in events:  # yields None on each idle poll window
-            if event is not None:
-                job.process_event(*event)
-                if job.checkpoint_manager is not None:
-                    job.checkpoint_manager.maybe_save(job)
-            if job.check_silence() is not None:
-                break
+        try:
+            for event in events:  # yields None on each idle poll window
+                if event is not None:
+                    job.process_event(*event)
+                    if job.checkpoint_manager is not None:
+                        job.checkpoint_manager.maybe_save(job)
+                    n_events += 1
+                    if tracing and n_events >= profile_steps:
+                        import jax
+
+                        jax.profiler.stop_trace()
+                        tracing = False
+                if job.check_silence() is not None:
+                    break
+        finally:
+            if tracing:
+                import jax
+
+                jax.profiler.stop_trace()
     elif "events" in flags:
         job.run(combined_events(flags["events"]))
     else:

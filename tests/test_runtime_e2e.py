@@ -156,6 +156,50 @@ class TestCentralizedEndToEnd:
         # all 500 records participate (minus holdout + ragged tail)
         assert stats.fitted > 300
 
+    def test_update_replaces_pipeline(self):
+        """Update recreates the pipeline with the new spec (the reference
+        broadcasts Update like Create, FlinkSpoke.scala:144-156)."""
+        import json as _json
+
+        job = StreamJob(JobConfig(parallelism=2, batch_size=16, test_set_size=16))
+        create = {
+            "id": 0, "request": "Create", "requestId": 1,
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "trainingConfiguration": {"protocol": "Asynchronous"},
+        }
+        update = dict(create)
+        update["request"] = "Update"
+        update["requestId"] = 2
+        update["learner"] = {"name": "ORR", "hyperParameters": {"lambda": 0.1}}
+        query = {"id": 0, "request": "Query", "requestId": 3}
+        rng = np.random.RandomState(0)
+
+        def recs(n, seed):
+            r = np.random.RandomState(seed)
+            out = []
+            for i in range(n):
+                x = r.randn(4)
+                out.append((TRAINING_STREAM, _json.dumps({
+                    "id": i,
+                    "numericalFeatures": [round(float(v), 4) for v in x],
+                    "target": float(x.sum() > 0),
+                })))
+            return out
+
+        events = (
+            [(REQUEST_STREAM, _json.dumps(create))]
+            + recs(200, 1)
+            + [(REQUEST_STREAM, _json.dumps(update))]
+            + recs(200, 2)
+            + [(REQUEST_STREAM, _json.dumps(query))]
+        )
+        job.run(events)
+        user_resps = [r for r in job.responses if r.response_id == 3]
+        assert user_resps, "no query response after update"
+        assert user_resps[0].learner["name"] == "ORR"
+        # the replaced pipeline restarted its fitted counter
+        assert user_resps[0].data_fitted <= 200 * 2
+
     def test_delete_stops_training(self):
         cfg = JobConfig(parallelism=1, batch_size=16)
         job = StreamJob(cfg)

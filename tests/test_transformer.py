@@ -7,6 +7,8 @@ the MoE all_to_all dispatch, and the gradient psums inserted by shard_map's
 varying-axis tracking, all at once.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -154,6 +156,27 @@ def test_step_many_matches_sequential_steps():
     for a, b in zip(
         jax.tree_util.tree_leaves(seq.host_params()),
         jax.tree_util.tree_leaves(many.host_params()),
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint rematerialization changes memory, not math — sharded
+    training with remat equals the plain single-device run."""
+    rng = np.random.RandomState(7)
+    tokens, targets, mask = _copy_batch(rng, 4, 16, CFG.vocab_size)
+    plain = SeqTrainer(CFG, mesh=make_seq_mesh(1, 1, 1), lr=1e-2, seed=17)
+    rcfg = dataclasses.replace(CFG, remat=True)
+    remat = SeqTrainer(rcfg, mesh=make_seq_mesh(2, 2, 2), lr=1e-2, seed=17)
+    for _ in range(3):
+        l_a = plain.step(tokens, targets, mask)
+        l_b = remat.step(tokens, targets, mask)
+    np.testing.assert_allclose(
+        float(np.asarray(l_a)), float(np.asarray(l_b)), atol=1e-4
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.host_params()),
+        jax.tree_util.tree_leaves(remat.host_params()),
     ):
         np.testing.assert_allclose(a, b, atol=2e-4)
 
