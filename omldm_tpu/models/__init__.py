@@ -6,6 +6,7 @@ framework's long-context extension, built on the attention kernels in
 omldm_tpu.ops and sharded by omldm_tpu.parallel.seq_trainer.
 """
 
+from omldm_tpu.models.decode import forward_with_cache, generate, init_kv_cache
 from omldm_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
@@ -16,4 +17,7 @@ __all__ = [
     "TransformerConfig",
     "init_transformer",
     "transformer_forward",
+    "init_kv_cache",
+    "forward_with_cache",
+    "generate",
 ]

@@ -1,0 +1,160 @@
+"""Incremental decoding: KV-cache serving path for the transformer family.
+
+The serving analogue of the streaming runtime's forecasting path
+(SURVEY.md §3.4) for sequence models: a prompt is prefilled once, then
+tokens are generated autoregressively with O(1) per-step compute against a
+preallocated KV cache — static shapes throughout, so the whole generation
+loop compiles to ONE XLA program (``lax.scan`` with the sampled token fed
+back through the carry; no host round trips between steps).
+
+Works with the dense transformer configs of omldm_tpu.models.transformer
+(single device; the cache layout [B, max_len, H, Dh] is also the natural
+sp/tp sharding target).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from omldm_tpu.models.transformer import (
+    TransformerConfig,
+    cast_params,
+    _rms_norm,
+)
+from omldm_tpu.ops.attention import NEG_INF
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch: int, max_len: Optional[int] = None
+) -> Dict[str, Any]:
+    """Preallocated per-layer K/V buffers + the current length."""
+    max_len = max_len or cfg.max_len
+    dh = cfg.d_model // cfg.n_heads
+    layer = lambda: {  # noqa: E731
+        "k": jnp.zeros((batch, max_len, cfg.n_heads, dh), cfg.dtype),
+        "v": jnp.zeros((batch, max_len, cfg.n_heads, dh), cfg.dtype),
+    }
+    return {
+        "layers": [layer() for _ in range(cfg.n_layers)],
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _cached_attention(q, kcache, vcache, q_pos0, n_valid):
+    """q: [B, T, H, Dh] at absolute positions q_pos0 + [0, T); attends
+    causally over cache rows [0, n_valid)."""
+    dh = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kcache.astype(jnp.float32)) / jnp.sqrt(float(dh))
+    k_pos = jnp.arange(kcache.shape[1])
+    q_pos = q_pos0 + jnp.arange(q.shape[1])
+    ok = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < n_valid)
+    s = jnp.where(ok[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vcache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def forward_with_cache(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,           # [B, T]
+    cache: Dict[str, Any],
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Process T tokens starting at cache['pos']: writes their K/V into the
+    cache and returns (logits [B, T, V], updated cache). T is static, so
+    prefill (T=prompt) and decode (T=1) each compile once."""
+    if cfg.n_experts:
+        raise ValueError("decode supports dense transformer configs")
+    if cfg.objective != "lm" or not cfg.causal:
+        raise ValueError(
+            "decode requires a causal lm config (the KV cache is causal and "
+            "the head must produce token logits)"
+        )
+    params = cast_params(params, cfg.dtype)
+    b, t = tokens.shape
+    dh = cfg.d_model // cfg.n_heads
+    pos0 = cache["pos"]
+    max_len = cache["layers"][0]["k"].shape[1]
+    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + t > max_len:
+        # concrete (eager) misuse is catchable; inside jit/scan the generate
+        # entry point enforces the bound up front
+        raise ValueError(
+            f"cache overflow: pos {int(pos0)} + {t} tokens > max_len {max_len}"
+        )
+    x = params["embed"][tokens] + jax.lax.dynamic_slice(
+        params["pos"], (pos0, 0), (t, params["pos"].shape[1])
+    )
+    new_layers = []
+    for layer, kv in zip(params["layers"], cache["layers"]):
+        z = _rms_norm(x, layer["ln1"]["g"])
+        qkv = jnp.einsum("bld,dke->blke", z, layer["wqkv"])
+        q = qkv[:, :, 0].reshape(b, t, cfg.n_heads, dh)
+        k = qkv[:, :, 1].reshape(b, t, cfg.n_heads, dh)
+        v = qkv[:, :, 2].reshape(b, t, cfg.n_heads, dh)
+        kc = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype),
+                                          (0, pos0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype),
+                                          (0, pos0, 0, 0))
+        new_layers.append({"k": kc, "v": vc})
+        o = _cached_attention(q, kc, vc, pos0, pos0 + t)
+        x = x + o.reshape(b, t, cfg.d_model) @ layer["wo"]
+        z = _rms_norm(x, layer["ln2"]["g"])
+        x = x + jax.nn.relu(z @ layer["w1"]) @ layer["w2"]
+    x = _rms_norm(x, params["ln_f"]["g"])
+    logits = x @ params["head"]
+    return logits, {"layers": new_layers, "pos": pos0 + t}
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    prompt: jnp.ndarray,           # [B, T_prompt]
+    n_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jnp.ndarray:
+    """Prefill + n_tokens greedy (temperature 0) or sampled decode steps,
+    fully on device. Returns the generated tokens [B, n_tokens]."""
+    b, t_prompt = prompt.shape
+    max_len = max_len or cfg.max_len
+    if max_len > cfg.max_len:
+        # the positional table has cfg.max_len rows; a longer cache would
+        # silently clamp position lookups past the table
+        raise ValueError(
+            f"max_len {max_len} exceeds the model's positional table "
+            f"(cfg.max_len {cfg.max_len})"
+        )
+    if t_prompt + n_tokens > max_len:
+        raise ValueError(
+            f"prompt ({t_prompt}) + n_tokens ({n_tokens}) exceeds "
+            f"max_len {max_len}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = forward_with_cache(cfg, params, prompt, cache)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature, axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    keys = jax.random.split(rng, n_tokens)
+    tok0 = pick(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        cache, tok = carry
+        logits, cache = forward_with_cache(cfg, params, tok[:, None], cache)
+        nxt = pick(logits[:, 0], key)
+        return (cache, nxt), nxt
+
+    # n_tokens-1 decode forwards: the token picked in an iteration is also
+    # that iteration's output, so no trailing forward is wasted
+    (_, _), rest = jax.lax.scan(step, (cache, tok0), keys[1:])
+    return jnp.concatenate([tok0[:, None], jnp.transpose(rest)], axis=1)

@@ -55,8 +55,11 @@ def save_trainer_state(trainer: Any, directory: str) -> None:
 
 
 def load_trainer_state(trainer: Any, directory: str) -> None:
-    """Restore :func:`save_trainer_state` output onto the trainer's mesh
-    (same config/mesh shape required)."""
+    """Restore :func:`save_trainer_state` output onto the trainer's mesh.
+
+    The snapshot holds the GLOBAL (unsharded) tree, so the restoring
+    trainer may use a different mesh shape than the saver (train on a
+    dp/sp/tp mesh, serve single-chip) — only the model config must match."""
     host = load_tree(directory)
     trainer.params = place_tree(host["params"], trainer._pspecs, trainer.mesh)
     trainer.opt = place_tree(host["opt"], trainer._ospecs, trainer.mesh)

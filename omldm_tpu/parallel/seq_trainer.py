@@ -176,7 +176,14 @@ class SeqTrainer:
     def step(self, tokens, targets, mask=None, valid_count=None) -> jnp.ndarray:
         """One global training step; returns the (lazy) global mean loss.
         Pass ``valid_count`` when ``mask`` is device-resident to avoid a
-        device->host copy for the fitted counter."""
+        device->host copy for the fitted counter.
+
+        NOTE: steps dispatch asynchronously. On the CPU backend (virtual
+        multi-device testing) queueing hundreds of sharded steps without
+        ever materializing a result can deadlock XLA's in-process
+        collective rendezvous — materialize a loss periodically, or use
+        :meth:`step_many`, which bounds the queue to one program per T
+        batches (and is faster everywhere)."""
         if mask is None:
             mask = np.ones(np.shape(tokens), np.float32)
             valid_count = int(mask.sum()) if valid_count is None else valid_count
