@@ -25,7 +25,12 @@ Layer map (mirrors SURVEY.md section 1):
     - ``omldm_tpu.parallel``      mesh / sharding / collective utilities
     - ``omldm_tpu.runtime``       host-side stream runtime (spoke/hub/job)
     - ``omldm_tpu.checkpoint``    snapshot / restore / rescale-merge
-    - ``omldm_tpu.ops``           pallas kernels for hot ops
+    - ``omldm_tpu.ops``           pallas kernels for hot ops (flash/ring/
+                                  ulysses attention, PA scan) + native C++
+    - ``omldm_tpu.models``        sequence-model family (transformer,
+                                  MoE, KV-cache decode) — long-context
+                                  scope beyond the reference
+    - ``omldm_tpu.utils``         tracing / profiling / shared helpers
 """
 
 __version__ = "0.1.0"

@@ -1,6 +1,24 @@
 """Mesh / sharding / collective engine: the SPMD performance path."""
 
 from omldm_tpu.parallel.mesh import make_mesh
+from omldm_tpu.parallel.multihost import (
+    host_local_array,
+    initialize_multihost,
+    make_multihost_mesh,
+)
+from omldm_tpu.parallel.pipeline_parallel import PPTrainer, make_pp_mesh
+from omldm_tpu.parallel.seq_trainer import SeqTrainer, make_seq_mesh
 from omldm_tpu.parallel.spmd import SPMD_PROTOCOLS, SPMDTrainer
 
-__all__ = ["make_mesh", "SPMDTrainer", "SPMD_PROTOCOLS"]
+__all__ = [
+    "make_mesh",
+    "SPMDTrainer",
+    "SPMD_PROTOCOLS",
+    "SeqTrainer",
+    "make_seq_mesh",
+    "PPTrainer",
+    "make_pp_mesh",
+    "initialize_multihost",
+    "make_multihost_mesh",
+    "host_local_array",
+]

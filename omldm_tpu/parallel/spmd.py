@@ -456,6 +456,18 @@ class SPMDTrainer:
         host_state = load_tree(directory)
         self.state = place_tree(host_state, self._state_specs, self.mesh)
 
+    def predict(self, x) -> np.ndarray:
+        """Serve with the worker-0 model (post-sync replicas agree):
+        transform through its preprocessor state, then learner.predict."""
+        params = jax.tree_util.tree_map(
+            lambda l: jax.device_get(l)[0, 0], self.state["params"]
+        )
+        z = jnp.asarray(x)
+        for prep, s in zip(self.preps, self.state["preps"]):
+            s0 = jax.tree_util.tree_map(lambda l: jax.device_get(l)[0, 0], s)
+            z = prep.transform(s0, z)
+        return np.asarray(self.learner.predict(params, z))
+
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """Loss/score of the worker-0 model on a host-side holdout set."""
         params = jax.tree_util.tree_map(

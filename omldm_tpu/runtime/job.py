@@ -15,8 +15,9 @@ Kafka sources, with the same termination protocol driven by a silence timer.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from omldm_tpu.api.data import FORECASTING, TRAINING, DataInstance, Prediction
 from omldm_tpu.api.requests import Request, RequestType
@@ -70,6 +71,9 @@ class StreamJob:
         self._rr = 0  # round-robin data partitioner (the reference rebalances)
         self._pending_creates: List[Request] = []  # awaiting dim inference
         self._dims: dict = {}  # network_id -> feature dim
+        # pipelines deployed on the SPMD collective engine instead of the
+        # host plane (trainingConfiguration {"engine": "spmd"})
+        self.spmd_bridges: Dict[int, Any] = {}
         # opt-in periodic checkpointing (Job.scala:120, Checkpointing.scala)
         self.checkpoint_manager = None
         if self.config.checkpointing:
@@ -164,6 +168,7 @@ class StreamJob:
             for spoke in self.spokes:
                 spoke.handle_request(request, 0)
             self.hub_manager.delete_network(request.id)
+            self.spmd_bridges.pop(request.id, None)
             self._dims.pop(request.id, None)
             # a pipeline deleted before dim inference must not resurrect
             self._pending_creates = [
@@ -175,10 +180,16 @@ class StreamJob:
                 # inference): no worker hosts it, so no fragments would ever
                 # arrive — drop the query instead of leaking an expectation
                 return
+            rid = request.request_id if request.request_id is not None else 0
+            bridge = self.spmd_bridges.get(request.id)
+            if bridge is not None:
+                # the fleet is one logical model: a single fragment set
+                self.response_merger.expect(rid, 1)
+                bridge.emit_query_response(rid)
+                return
             targets = self.pipeline_manager.query_targets(
                 request, self.config.parallelism
             )
-            rid = request.request_id if request.request_id is not None else 0
             self.response_merger.expect(rid, len(targets))
             for w in targets:
                 self.spokes[w].handle_request(request, self._dims.get(request.id, 0))
@@ -205,12 +216,32 @@ class StreamJob:
         """Create the pipeline on every worker and its hub shard(s) —
         the reference broadcasts a ControlMessage per worker
         (PipelineMap.scala:54-57) and spoke 0 creates each of the
-        hubParallelism hubs (FlinkSpoke.scala:220-222)."""
-        # an Update must rebuild the hub side too (protocol/learner/dim may
-        # have changed); create_hub is a no-op for existing keys otherwise
+        hubParallelism hubs (FlinkSpoke.scala:220-222). A request whose
+        trainingConfiguration sets {"engine": "spmd"} (and a supported
+        protocol/learner) deploys on the SPMD collective engine instead."""
+        from omldm_tpu.runtime.spmd_bridge import (
+            SPMDBridge,
+            spmd_engine_requested,
+            spmd_engine_supported,
+        )
+
+        use_spmd = spmd_engine_requested(request) and spmd_engine_supported(request)
+        # an Update must tear down the previous deployment on EITHER plane
         if request.id in self._dims:
             self.hub_manager.delete_network(request.id)
+            self.spmd_bridges.pop(request.id, None)
+            if use_spmd:
+                # clear stale host-plane nets when switching planes
+                delete = dataclasses.replace(request, request=RequestType.DELETE)
+                for spoke in self.spokes:
+                    spoke.handle_request(delete, 0)
         self._dims[request.id] = dim
+        if use_spmd:
+            self.spmd_bridges[request.id] = SPMDBridge(
+                request, dim, self.config,
+                self._emit_prediction, self._route_response_fragment,
+            )
+            return
         for spoke in self.spokes:
             spoke.handle_request(request, dim)
         for h in range(request.training_configuration.hub_parallelism):
@@ -229,6 +260,10 @@ class StreamJob:
         spoke = self.spokes[self._rr % len(self.spokes)]
         self._rr += 1
         spoke.handle_data(inst)
+        # SPMD-engine pipelines see every record (the bridge spreads them
+        # across its mesh worker slots internally)
+        for bridge in self.spmd_bridges.values():
+            bridge.handle_data(inst)
 
     # --- run loops ---
 
@@ -264,6 +299,11 @@ class StreamJob:
         self.stats.probe_fired = True
         for spoke in self.spokes:
             spoke.handle_terminate_probe()
+        for bridge in self.spmd_bridges.values():
+            bridge.handle_terminate_probe()
+            self.stats.add_hub_statistics(
+                bridge.request.id, bridge.network_statistics()
+            )
         self.hub_manager.on_terminate()
         for net_id in self.pipeline_manager.live_pipelines:
             merged = self.hub_manager.network_statistics(net_id)

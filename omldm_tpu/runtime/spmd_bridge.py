@@ -1,0 +1,229 @@
+"""SPMDBridge: host one streaming pipeline on the collective SPMD engine.
+
+The streaming runtime's host plane multiplexes pipelines across in-process
+spokes (message-passing protocol sync, SURVEY.md §3.3); this bridge is the
+second deployment mode: a pipeline whose ``trainingConfiguration`` sets
+``{"engine": "spmd"}`` trains on :class:`omldm_tpu.parallel.SPMDTrainer`
+instead — every data-parallel worker is a mesh shard and protocol sync is
+an XLA collective over ICI, while the pipeline keeps the EXACT streaming
+contract of a host-plane pipeline: 8-of-10 holdout sampling, micro-batch
+training of evicted/kept records, forecasting predictions, bucketed query
+responses, the responseId -1 termination fragments (one per configured
+worker so the parallelism x pipelines countdown is preserved,
+StatisticsOperator.scala:109), and protocol statistics with
+bytesShipped/modelsShipped accounting from the collective call sites.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from omldm_tpu.api.data import FORECASTING, DataInstance, Prediction
+from omldm_tpu.api.requests import Request
+from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
+from omldm_tpu.api.stats import Statistics
+from omldm_tpu.config import JobConfig
+from omldm_tpu.parallel.mesh import make_mesh
+from omldm_tpu.parallel.spmd import SPMD_PROTOCOLS, SPMDTrainer
+from omldm_tpu.runtime.databuffers import DataSet
+from omldm_tpu.runtime.spoke import PREDICT_BATCH
+from omldm_tpu.runtime.vectorizer import Vectorizer
+
+
+def spmd_engine_requested(request: Request) -> bool:
+    return (
+        str(request.training_configuration.extra.get("engine", "")).lower()
+        == "spmd"
+    )
+
+
+def spmd_engine_supported(request: Request) -> bool:
+    """The engine hosts the 6 collective protocols with device learners;
+    anything else falls back to the host plane."""
+    protocol = request.training_configuration.protocol
+    learner = request.learner.name if request.learner else ""
+    return protocol in SPMD_PROTOCOLS and learner not in ("HT",)
+
+
+class SPMDBridge:
+    """One pipeline, streaming in, trained across the device mesh."""
+
+    def __init__(
+        self,
+        request: Request,
+        dim: int,
+        config: JobConfig,
+        emit_prediction: Callable[[Prediction], None],
+        emit_response: Callable[[QueryResponse], None],
+    ):
+        self.request = request
+        self.config = config
+        self._emit_prediction = emit_prediction
+        self._emit_response = emit_response
+        tc = request.training_configuration
+        n_dev = len(jax.devices())
+        hub = max(int(tc.hub_parallelism), 1)
+        if hub > n_dev:
+            hub = 1
+        # as many mesh workers as devices allow, capped by the job's
+        # configured parallelism (the virtual worker count for statistics)
+        dp = max(min(config.parallelism, n_dev // hub), 1)
+        self.trainer = SPMDTrainer(
+            request.learner,
+            request.preprocessors or (),
+            dim=dim,
+            protocol=tc.protocol,
+            mesh=make_mesh(dp=dp, hub=hub),
+            training_configuration=tc,
+            batch_size=config.batch_size,
+        )
+        self.dp = dp
+        hash_dims = int(tc.extra.get("hashDims", 0))
+        self.vectorizer = Vectorizer(dim, hash_dims)
+        self.dim = dim
+        self.test_set: DataSet[Tuple[np.ndarray, float]] = DataSet(
+            config.test_set_size
+        )
+        self.holdout_count = 0
+        # staged rows round-robined across the dp worker slots
+        self._rows_x: List[np.ndarray] = []
+        self._rows_y: List[float] = []
+
+    # --- data path ---
+
+    def handle_data(self, inst: DataInstance) -> None:
+        x = self.vectorizer.vectorize(inst)
+        if inst.operation == FORECASTING:
+            xb = np.zeros((PREDICT_BATCH, self.dim), np.float32)
+            xb[0] = x
+            preds = self.trainer.predict(xb)
+            self._emit_prediction(
+                Prediction(self.request.id, inst, float(preds[0]))
+            )
+            return
+        y = 0.0 if inst.target is None else float(inst.target)
+        # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
+        c = self.holdout_count % 10
+        self.holdout_count += 1
+        if self.config.test and c >= 8:
+            evicted = self.test_set.append((x, y))
+            if evicted is None:
+                return
+            x, y = evicted
+        self._rows_x.append(x)
+        self._rows_y.append(y)
+        if len(self._rows_x) >= self.dp * self.config.batch_size:
+            self._train_staged()
+
+    def _train_staged(self) -> None:
+        """Train the staged rows as one [dp, B, D] fleet step (padded with
+        a zero mask when the stage is partial)."""
+        n = len(self._rows_x)
+        if n == 0:
+            return
+        b = self.config.batch_size
+        total = self.dp * b
+        x = np.zeros((total, self.dim), np.float32)
+        y = np.zeros((total,), np.float32)
+        mask = np.zeros((total,), np.float32)
+        x[:n] = np.stack(self._rows_x)
+        y[:n] = np.asarray(self._rows_y, np.float32)
+        mask[:n] = 1.0
+        self._rows_x, self._rows_y = [], []
+        self.trainer.step(
+            x.reshape(self.dp, b, self.dim),
+            y.reshape(self.dp, b),
+            mask.reshape(self.dp, b),
+            valid_count=n,
+        )
+
+    def flush(self) -> None:
+        self._train_staged()
+
+    # --- query / termination path ---
+
+    def _evaluate(self) -> Tuple[float, float]:
+        if self.test_set.is_empty:
+            return 0.0, 0.0
+        xs = np.stack([p[0] for p in self.test_set])
+        ys = np.asarray([p[1] for p in self.test_set], np.float32)
+        return self.trainer.evaluate(xs, ys, np.ones(len(ys), np.float32))
+
+    def emit_query_response(self, response_id: int) -> None:
+        """Bucketed QueryResponse (FlinkNetwork.scala:48-149,151-240); the
+        fleet model is one logical model, so user queries get a single
+        worker's fragment set (the merger expects 1)."""
+        self.flush()
+        loss, score = self._evaluate()
+        flat = self.trainer.global_flat_params()
+        chunks: List[Optional[np.ndarray]] = [None]
+        if response_id != TERMINATION_RESPONSE_ID:
+            bucket = self.config.max_param_bucket_size
+            chunks = [
+                flat[i : i + bucket]
+                for i in range(0, max(flat.size, 1), bucket)
+            ] or [None]
+        tc = self.request.training_configuration
+        learner_desc = {
+            "name": self.request.learner.name,
+            "hyperParameters": dict(self.request.learner.hyper_parameters or {}),
+            "dataStructure": dict(self.request.learner.data_structure or {}),
+        }
+        n_workers = (
+            self.config.parallelism
+            if response_id == TERMINATION_RESPONSE_ID
+            else 1
+        )
+        fitted = self.trainer.fitted
+        for w in range(n_workers):
+            for i, chunk in enumerate(chunks):
+                learner = (
+                    dict(learner_desc) if i == 0
+                    else {"name": learner_desc["name"]}
+                )
+                if chunk is not None:
+                    learner["parameters"] = {"bucketValues": chunk.tolist()}
+                self._emit_response(
+                    QueryResponse(
+                        response_id=response_id,
+                        mlp_id=self.request.id,
+                        bucket=i,
+                        num_buckets=len(chunks),
+                        preprocessors=[
+                            {"name": p.name, "hyperParameters": dict(p.hyper_parameters or {})}
+                            for p in (self.request.preprocessors or [])
+                        ] if i == 0 else None,
+                        learner=learner,
+                        protocol=tc.protocol if i == 0 else None,
+                        # fitted counts once across the fleet's fragments
+                        data_fitted=fitted if (i == 0 and w == 0) else 0,
+                        loss=loss if i == 0 else None,
+                        cumulative_loss=None,
+                        score=score if i == 0 else None,
+                        source_worker=w,
+                    )
+                )
+
+    def handle_terminate_probe(self) -> None:
+        self.emit_query_response(TERMINATION_RESPONSE_ID)
+
+    def network_statistics(self) -> Statistics:
+        """Protocol statistics with the collective-call-site accounting
+        (bytesShipped parity, FlinkHub.scala:118-127)."""
+        curve = self.trainer.curve_slice()
+        _, score = self._evaluate()
+        return Statistics(
+            pipeline=self.request.id,
+            protocol=self.request.training_configuration.protocol,
+            models_shipped=self.trainer.sync_count() * self.dp,
+            bytes_shipped=self.trainer.bytes_shipped(),
+            num_of_blocks=self.trainer.sync_count(),
+            fitted=self.trainer.fitted,
+            learning_curve=[l for l, _ in curve],
+            lcx=[f for _, f in curve],
+            mean_buffer_size=float(len(self._rows_x)),
+            score=score,
+        )

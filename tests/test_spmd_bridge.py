@@ -1,0 +1,126 @@
+"""SPMD engine inside the streaming job: {"engine": "spmd"} pipelines train
+on the collective mesh while keeping the full streaming contract."""
+
+import json
+
+import numpy as np
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+)
+
+
+def stream_lines(n, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim)
+    y = (x @ w > 0).astype(np.float64)
+    return [
+        json.dumps(
+            {"numericalFeatures": list(np.round(x[i], 5)), "target": float(y[i])}
+        )
+        for i in range(n)
+    ]
+
+
+def make_create(net_id=0, protocol="Synchronous", engine="spmd", learner="PA"):
+    return {
+        "id": net_id,
+        "request": "Create",
+        "learner": {"name": learner, "hyperParameters": {"C": 1.0}},
+        "trainingConfiguration": {
+            "protocol": protocol,
+            "syncEvery": 2,
+            "engine": engine,
+        },
+    }
+
+
+def test_spmd_pipeline_full_lifecycle():
+    job = StreamJob(JobConfig(parallelism=4, batch_size=32, test_set_size=32))
+    events = [(REQUEST_STREAM, json.dumps(make_create()))] + [
+        (TRAINING_STREAM, l) for l in stream_lines(3000)
+    ]
+    report = job.run(events)
+    assert 0 in job.spmd_bridges
+    assert report is not None
+    [stats] = report.statistics
+    assert stats.protocol == "Synchronous"
+    assert stats.score > 0.85, stats.score
+    assert stats.fitted > 2000
+    assert stats.bytes_shipped > 0 and stats.models_shipped > 0
+    assert len(stats.learning_curve) > 0
+
+
+def test_spmd_pipeline_forecasting_and_query():
+    job = StreamJob(JobConfig(parallelism=2, batch_size=32, test_set_size=32))
+    rng = np.random.RandomState(1)
+    query = {"id": 0, "request": "Query", "requestId": 9}
+    events = (
+        [(REQUEST_STREAM, json.dumps(make_create(protocol="GM")))]
+        + [(TRAINING_STREAM, l) for l in stream_lines(1200)]
+        + [
+            (FORECASTING_STREAM, json.dumps(
+                {"id": i, "numericalFeatures": list(np.round(rng.randn(6), 4))}
+            ))
+            for i in range(5)
+        ]
+        + [(REQUEST_STREAM, json.dumps(query))]
+    )
+    job.run(events)
+    assert len(job.predictions) == 5
+    user = [r for r in job.responses if r.response_id == 9]
+    assert user, "no query response from the spmd pipeline"
+    assert user[0].learner["name"] == "PA"
+    # the merger re-assembles the param buckets into one "values" vector
+    assert len(user[0].learner.get("parameters", {}).get("values", [])) > 0
+    assert user[0].protocol == "GM"
+
+
+def test_mixed_host_and_spmd_pipelines():
+    """A host-plane pipeline and an SPMD-engine pipeline coexist; both learn."""
+    job = StreamJob(JobConfig(parallelism=2, batch_size=32, test_set_size=32))
+    events = (
+        [
+            (REQUEST_STREAM, json.dumps(make_create(net_id=0, engine="spmd"))),
+            (REQUEST_STREAM, json.dumps(
+                make_create(net_id=1, engine="", protocol="Asynchronous")
+            )),
+        ]
+        + [(TRAINING_STREAM, l) for l in stream_lines(2400)]
+    )
+    report = job.run(events)
+    assert report is not None
+    by_id = {s.pipeline: s for s in report.statistics}
+    assert set(by_id) == {0, 1}
+    assert by_id[0].score > 0.8, f"spmd: {by_id[0].score}"
+    assert by_id[1].score > 0.8, f"host: {by_id[1].score}"
+
+
+def test_spmd_delete_removes_bridge():
+    job = StreamJob(JobConfig(parallelism=2, batch_size=16, test_set_size=16))
+    delete = {"id": 0, "request": "Delete"}
+    events = (
+        [(REQUEST_STREAM, json.dumps(make_create()))]
+        + [(TRAINING_STREAM, l) for l in stream_lines(200)]
+        + [(REQUEST_STREAM, json.dumps(delete))]
+    )
+    job.run(events, terminate_on_end=False)
+    assert 0 not in job.spmd_bridges
+
+
+def test_unsupported_protocol_falls_back_to_host_plane():
+    """engine=spmd with a non-collective protocol deploys on the host plane."""
+    job = StreamJob(JobConfig(parallelism=1, batch_size=16, test_set_size=16))
+    events = [
+        (REQUEST_STREAM, json.dumps(
+            make_create(protocol="CentralizedTraining", engine="spmd")
+        )),
+    ] + [(TRAINING_STREAM, l) for l in stream_lines(200)]
+    report = job.run(events)
+    assert 0 not in job.spmd_bridges
+    assert report is not None  # trained on the host plane instead
