@@ -218,6 +218,125 @@ def bench_longctx_transformer(steps):
     return "longctx_transformer_lm", thr
 
 
+def bench_e2e_stream(n_records=300_000, parallelism=1):
+    """JSON-bytes -> trained-params END-TO-END throughput: the real CLI
+    ingest route (C++ block parse -> prefetch thread -> packed batches ->
+    SPMD staged chained steps), timed from first byte consumed to the
+    trained parameters materialized on host. Nothing is pre-staged on the
+    device; this is the number the reference's whole-job throughput maps to
+    (Job.scala:42-70 -> FlinkSpoke.scala:92-107 hot loop)."""
+    import tempfile
+
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.fast_ingest import iter_file_batches
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+    from omldm_tpu.runtime.prefetch import prefetch
+
+    dim = 28
+    rng = np.random.RandomState(0)
+    w = rng.randn(dim)
+    # generate the stream file (not timed)
+    tmp = tempfile.NamedTemporaryFile(
+        "w", suffix=".jsonl", delete=False
+    )
+    chunk = 20_000
+    written = 0
+    while written < n_records:
+        n = min(chunk, n_records - written)
+        x = np.round(rng.randn(n, dim), 6)
+        y = (x @ w > 0).astype(np.float32)
+        lines = [
+            '{"numericalFeatures": [%s], "target": %.1f, "operation": "training"}'
+            % (", ".join("%.6f" % v for v in x[i]), y[i])
+            for i in range(n)
+        ]
+        tmp.write("\n".join(lines) + "\n")
+        written += n
+    tmp.close()
+    n_bytes = os.path.getsize(tmp.name)
+
+    create = {
+        "id": 0,
+        "request": "Create",
+        "learner": {
+            "name": "Softmax",
+            "hyperParameters": {"learningRate": 0.05, "nClasses": 2},
+            "dataStructure": {"nFeatures": dim},
+        },
+        "preProcessors": [],
+        "trainingConfiguration": {
+            "protocol": "Synchronous",
+            "engine": "spmd",
+            "extra": {"stageChain": 8},
+        },
+    }
+    job = StreamJob(JobConfig(parallelism=parallelism, batch_size=4096))
+    job.process_event(REQUEST_STREAM, json.dumps(create))
+    [bridge] = job.spmd_bridges.values()
+
+    # compile warmup (steady-state measurement): trace both launch shapes
+    # on dummy data, then restore the untouched initial state
+    import jax
+
+    import jax.numpy as jnp
+
+    tr = bridge.trainer
+    # deep-copy: the jitted steps donate their input state buffers
+    state0 = jax.tree.map(
+        lambda a: jnp.array(a, copy=True) if isinstance(a, jax.Array) else a,
+        tr.state,
+    )
+    dp, b = bridge.dp, 4096
+    zx = np.zeros((bridge.chain, dp, b, dim), np.float32)
+    zy = np.zeros((bridge.chain, dp, b), np.float32)
+    zm = np.ones((bridge.chain, dp, b), np.float32)
+    tr.step_many(zx, zy, zm)
+    tr.step(zx[0], zy[0], zm[0], valid_count=dp * b)
+    jax.block_until_ready(tr.state["params"])
+    tr.state = state0
+    # reset the host-side counters the warmup advanced
+    tr._fitted_host = 0
+    tr._steps_host = 0
+    tr._curve = []
+
+    t0 = time.perf_counter()
+    for batch in prefetch(iter_file_batches(tmp.name, dim, 16384), depth=3):
+        job.process_packed_batch(*batch)
+    bridge.flush()
+    # materialized host params = the full-pipeline completion barrier
+    flat = bridge.trainer.global_flat_params()
+    float(np.asarray(flat[0]))
+    dt = time.perf_counter() - t0
+    os.unlink(tmp.name)
+    return "e2e_json_to_params", n_records / dt, {
+        "bytes_per_sec": round(n_bytes / dt, 1),
+        "records": n_records,
+        "fitted": bridge.trainer.fitted,
+    }
+
+
+def _tunnel_floor_ms(samples=100):
+    """p50 of a trivial jitted dispatch+materialize round trip — the
+    environment's per-dispatch cost (network tunnel to the TPU). Subtracting
+    it from serving latency gives the tunnel-corrected framework latency."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + 1.0)
+    x = jnp.zeros(())
+    for _ in range(5):
+        np.asarray(f(x))
+    lat = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.percentile(lat, 50))
+
+
 def bench_prediction_latency():
     """p50/p99 single-record serving latency through the padded predict path."""
     import jax
@@ -248,6 +367,7 @@ def bench_prediction_latency():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--e2e-records", type=int, default=300_000)
     args = ap.parse_args()
 
     for fn in (
@@ -269,6 +389,18 @@ def main():
                 }
             )
         )
+    name, thr, extra = bench_e2e_stream(n_records=args.e2e_records)
+    print(
+        json.dumps(
+            {
+                "config": name,
+                "metric": "examples/sec (JSON bytes -> trained params)",
+                "value": round(thr, 1),
+                **extra,
+            }
+        )
+    )
+    floor = _tunnel_floor_ms()
     p50, p99 = bench_prediction_latency()
     print(
         json.dumps(
@@ -277,11 +409,13 @@ def main():
                 "metric": "single-record p50/p99 ms",
                 "p50_ms": round(p50, 3),
                 "p99_ms": round(p99, 3),
+                "dispatch_floor_p50_ms": round(floor, 3),
+                "p50_tunnel_corrected_ms": round(max(p50 - floor, 0.0), 3),
                 "note": (
-                    "includes this environment's TPU network-tunnel round "
-                    "trip (~67 ms floor measured with a trivial jit); "
-                    "on locally-attached TPU hardware the serving path is "
-                    "sub-millisecond"
+                    "raw latency includes this environment's TPU "
+                    "network-tunnel round trip; the corrected figure "
+                    "subtracts the p50 of a trivial jitted dispatch "
+                    "(the tunnel floor) and is the framework's own cost"
                 ),
             }
         )

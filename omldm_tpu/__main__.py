@@ -37,6 +37,7 @@ from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime.ingest import file_events, interleave
 from omldm_tpu.runtime.job import (
     FORECASTING_STREAM,
+    PACKED_STREAM,
     REQUEST_STREAM,
     TRAINING_STREAM,
     StreamJob,
@@ -110,8 +111,21 @@ def build_job(flags: Dict[str, str]) -> Tuple[StreamJob, List[_FileSink]]:
     return job, [pred_sink, resp_sink, perf_sink]
 
 
+def _ensure_backend() -> None:
+    """Fall back to the CPU backend when the configured accelerator can't
+    initialize (e.g. the TPU tunnel is down) instead of crashing the job."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     flags = parse_flags(sys.argv[1:] if argv is None else argv)
+    _ensure_backend()
     job, sinks = build_job(flags)
     from omldm_tpu.utils import trace
 
@@ -184,11 +198,20 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
     elif "events" in flags:
         job.run(combined_events(flags["events"]))
     else:
-        sources = [
-            file_events(flags[topic], topic)
-            for topic in _STREAMS
-            if topic in flags
-        ]
+        packed = None
+        if (
+            TRAINING_STREAM in flags
+            and flags.get("fastIngest", "auto") != "false"
+        ):
+            packed = _packed_training_source(flags)
+        sources = []
+        for topic in _STREAMS:
+            if topic not in flags:
+                continue
+            if topic == TRAINING_STREAM and packed is not None:
+                sources.append(packed)
+            else:
+                sources.append(file_events(flags[topic], topic))
         if not sources:
             raise SystemExit(
                 "no sources: pass --trainingData/--forecastingData/"
@@ -197,6 +220,74 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
             )
         job.run(interleave(*sources))
     return 0
+
+
+def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
+    """(total feature dim, hash_dims) for the packed ingest path: from the
+    first Create/Update request carrying nFeatures, else inferred from the
+    first training record (the reference sizes models lazily on the first
+    record; here the packed batcher needs the width up front)."""
+    from omldm_tpu.api.data import DataInstance
+    from omldm_tpu.api.requests import Request, RequestType
+    from omldm_tpu.runtime.vectorizer import Vectorizer
+
+    if REQUEST_STREAM in flags:
+        try:
+            for _, line in file_events(flags[REQUEST_STREAM], REQUEST_STREAM):
+                req = Request.from_json(line)
+                if req is None or req.request not in (
+                    RequestType.CREATE, RequestType.UPDATE
+                ):
+                    continue
+                hash_dims = int(
+                    req.training_configuration.extra.get("hashDims", 0)
+                )
+                ds = req.learner.data_structure if req.learner else None
+                if ds and "nFeatures" in ds:
+                    return int(ds["nFeatures"]) + hash_dims, hash_dims
+                # first Create without an explicit width: infer from data
+                for _, dline in file_events(
+                    flags[TRAINING_STREAM], TRAINING_STREAM
+                ):
+                    inst = DataInstance.from_json(dline)
+                    if inst is not None:
+                        return Vectorizer.infer_dim(inst, hash_dims), hash_dims
+                return None
+        except OSError:
+            return None
+    try:
+        for _, dline in file_events(flags[TRAINING_STREAM], TRAINING_STREAM):
+            inst = DataInstance.from_json(dline)
+            if inst is not None:
+                return Vectorizer.infer_dim(inst, 0), 0
+    except OSError:
+        return None
+    return None
+
+
+def _packed_training_source(flags: Dict[str, str]):
+    """The training file as PACKED_STREAM events: C++ bulk parse ->
+    (x, y, op) blocks, prefetched one block ahead of the device feed.
+    Returns None when the width can't be pinned or (in auto mode) the
+    native parser is unavailable — callers fall back to per-record JSON."""
+    from omldm_tpu.ops.native import fast_parser_available
+    from omldm_tpu.runtime.fast_ingest import iter_file_batches
+    from omldm_tpu.runtime.prefetch import prefetch
+
+    spec = _stream_spec(flags)
+    if spec is None:
+        return None
+    if flags.get("fastIngest", "auto") != "true" and not fast_parser_available():
+        return None
+    dim, hash_dims = spec
+    batches = iter_file_batches(
+        flags[TRAINING_STREAM],
+        dim,
+        int(flags.get("ingestBatch", "8192")),
+        hash_dims,
+    )
+    depth = int(flags.get("prefetchDepth", "2"))
+    return ((PACKED_STREAM, b) for b in prefetch(batches, depth))
 
 
 if __name__ == "__main__":

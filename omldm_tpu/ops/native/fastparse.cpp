@@ -10,21 +10,99 @@
 // flagged so the caller can fall back to the Python parser (identical
 // drop/keep semantics).
 //
-// Build: g++ -O3 -shared -fPIC -o libfastparse.so fastparse.cpp
+// Build: g++ -O3 -shared -fPIC -pthread -o libfastparse.so fastparse.cpp
 //
 // Exposed C ABI:
 //   int omldm_parse_lines(buf, len, dim, max_records, x, y, op, valid)
+//   int omldm_parse_lines_mt(buf, len, dim, max_records, x, y, op, valid,
+//                            n_threads)
 // Returns the number of lines consumed. For line i:
 //   valid[i] = 1 parsed ok, 0 dropped (invalid/EOS), 2 needs Python fallback
 //   op[i]    = 0 training, 1 forecasting
 //   y[i]     = target (0 when absent); x[i*dim .. i*dim+dim) zero-padded.
+//
+// Throughput design (this is the part that keeps a TPU chip fed):
+// - ONE structural walk per line (key -> value, values skipped with memchr)
+//   instead of re-scanning the line for every known key;
+// - SWAR digit parsing: 8 or 4 ASCII digits converted per multiply chain
+//   (the classic 0x0F0F... mask + pairwise-merge trick) instead of a serial
+//   mant = mant*10 + d chain; strtod only for oddball syntax;
+// - the _mt entry indexes newline offsets then parses disjoint line ranges
+//   on std::threads (each line owns its output row; nothing is shared).
 
 #include <cctype>
 #include <cmath>
+#include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
+
+const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                         1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                         1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// --- SWAR digit runs -------------------------------------------------------
+
+inline bool all_digits4(uint32_t c) {
+  return ((c & 0xF0F0F0F0u) == 0x30303030u) &&
+         (((c + 0x06060606u) & 0xF0F0F0F0u) == 0x30303030u);
+}
+
+inline bool all_digits8(uint64_t c) {
+  return ((c & 0xF0F0F0F0F0F0F0F0ull) == 0x3030303030303030ull) &&
+         (((c + 0x0606060606060606ull) & 0xF0F0F0F0F0F0F0F0ull) ==
+          0x3030303030303030ull);
+}
+
+// 4 ASCII digits (little-endian load order = text order) -> value.
+inline uint32_t swar4(uint32_t c) {
+  uint32_t t = c & 0x0F0F0F0Fu;
+  t = (t * 10 + (t >> 8)) & 0x00FF00FFu;
+  t = (t * 100 + (t >> 16)) & 0x0000FFFFu;
+  return t;
+}
+
+// 8 ASCII digits -> value (Lemire's parse_eight_digits).
+inline uint64_t swar8(uint64_t c) {
+  c -= 0x3030303030303030ull;
+  c = (c * 10) + (c >> 8);
+  const uint64_t mask = 0x000000FF000000FFull;
+  const uint64_t mul1 = 0x000F424000000064ull;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ull;  // 1 + (10000 << 32)
+  c = (((c & mask) * mul1) + (((c >> 16) & mask) * mul2)) >> 32;
+  return c;
+}
+
+// Accumulate a digit run into mant; returns #digits consumed.
+inline int parse_digit_run(const char*& p, const char* end, uint64_t& mant) {
+  int digits = 0;
+  while (end - p >= 8) {
+    uint64_t c8;
+    memcpy(&c8, p, 8);
+    if (!all_digits8(c8)) break;
+    mant = mant * 100000000ull + swar8(c8);
+    digits += 8;
+    p += 8;
+  }
+  if (end - p >= 4) {
+    uint32_t c4;
+    memcpy(&c4, p, 4);
+    if (all_digits4(c4)) {
+      mant = mant * 10000ull + swar4(c4);
+      digits += 4;
+      p += 4;
+    }
+  }
+  while (p < end && *p >= '0' && *p <= '9') {
+    mant = mant * 10ull + static_cast<uint64_t>(*p - '0');
+    ++digits;
+    ++p;
+  }
+  return digits;
+}
 
 struct Cursor {
   const char* p;
@@ -35,15 +113,61 @@ inline void skip_ws(Cursor& c) {
   while (c.p < c.end && (*c.p == ' ' || *c.p == '\t')) ++c.p;
 }
 
-// Parse a JSON number at the cursor; returns false on malformed input.
+// JSON-number parse: [-]digits[.digits][e[±]dd]. Falls back to strtod when
+// the mantissa exceeds 19 digits or the syntax is unusual; rejects
+// NaN/Infinity (parity with DataInstance.is_valid).
 inline bool parse_number(Cursor& c, double* out) {
-  char* endp = nullptr;
-  double v = strtod(c.p, &endp);
-  if (endp == c.p || endp > c.end) return false;
-  if (!std::isfinite(v)) return false;  // NaN/Infinity are rejected (parity
-                                        // with DataInstance.is_valid)
-  c.p = endp;
-  *out = v;
+  const char* p = c.p;
+  const char* end = c.end;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  uint64_t mant = 0;
+  int digits = parse_digit_run(p, end, mant);
+  int frac = 0;
+  if (p < end && *p == '.') {
+    ++p;
+    frac = parse_digit_run(p, end, mant);
+    digits += frac;
+  }
+  if (digits == 0 || digits > 19) {
+    // empty ("-", ".") or precision/overflow-risky: defer to strtod
+    char* endp = nullptr;
+    double v = strtod(c.p, &endp);
+    if (endp == c.p || endp > c.end) return false;
+    if (!std::isfinite(v)) return false;
+    c.p = endp;
+    *out = v;
+    return true;
+  }
+  int exp10 = -frac;
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+      eneg = (*p == '-');
+      ++p;
+    }
+    int e = 0, edigs = 0;
+    while (p < end && *p >= '0' && *p <= '9' && edigs < 6) {
+      e = e * 10 + (*p - '0');
+      ++edigs;
+      ++p;
+    }
+    if (edigs == 0) return false;
+    exp10 += eneg ? -e : e;
+  }
+  double v = static_cast<double>(mant);
+  if (exp10 > 0) {
+    v = (exp10 > 22) ? v * std::pow(10.0, exp10) : v * kPow10[exp10];
+  } else if (exp10 < 0) {
+    v = (exp10 < -22) ? v / std::pow(10.0, -exp10) : v / kPow10[-exp10];
+  }
+  if (!std::isfinite(v)) return false;
+  c.p = p;
+  *out = neg ? -v : v;
   return true;
 }
 
@@ -80,19 +204,220 @@ inline bool parse_num_array(Cursor& c, float* dst, int cap, int* count) {
   return false;
 }
 
-// Find `"key"` at the top level of the line (naive scan is fine: the schema
-// has no nested objects with clashing keys except inside "metadata", which
-// triggers fallback below). Returns pointer past the ':' or nullptr.
-inline const char* find_key(const char* line, const char* end, const char* key) {
-  size_t klen = strlen(key);
-  for (const char* p = line; p + klen + 3 < end; ++p) {
-    if (*p == '"' && strncmp(p + 1, key, klen) == 0 && p[klen + 1] == '"') {
-      const char* q = p + klen + 2;
-      while (q < end && (*q == ' ' || *q == '\t')) ++q;
-      if (q < end && *q == ':') return q + 1;
+// --- single-pass structural walk ------------------------------------------
+
+// Known keys, matched by (length, bytes).
+enum KeyId {
+  KEY_NUMERICAL,
+  KEY_DISCRETE,
+  KEY_CATEGORICAL,
+  KEY_METADATA,
+  KEY_TARGET,
+  KEY_OPERATION,
+  KEY_UNKNOWN,
+};
+
+inline KeyId match_key(const char* k, size_t len) {
+  switch (len) {
+    case 17:
+      if (memcmp(k, "numericalFeatures", 17) == 0) return KEY_NUMERICAL;
+      break;
+    case 16:
+      if (memcmp(k, "discreteFeatures", 16) == 0) return KEY_DISCRETE;
+      break;
+    case 19:
+      if (memcmp(k, "categoricalFeatures", 19) == 0) return KEY_CATEGORICAL;
+      break;
+    case 8:
+      if (memcmp(k, "metadata", 8) == 0) return KEY_METADATA;
+      break;
+    case 6:
+      if (memcmp(k, "target", 6) == 0) return KEY_TARGET;
+      break;
+    case 9:
+      if (memcmp(k, "operation", 9) == 0) return KEY_OPERATION;
+      break;
+    default:
+      break;
+  }
+  return KEY_UNKNOWN;
+}
+
+// Skip a string; cursor sits on the opening '"'. Handles escapes.
+inline bool skip_string(Cursor& c) {
+  ++c.p;  // opening quote
+  while (c.p < c.end) {
+    const char* q =
+        static_cast<const char*>(memchr(c.p, '"', c.end - c.p));
+    if (!q) return false;
+    // count preceding backslashes for escape parity
+    int bs = 0;
+    const char* b = q - 1;
+    while (b >= c.p && *b == '\\') {
+      ++bs;
+      --b;
+    }
+    c.p = q + 1;
+    if ((bs & 1) == 0) return true;
+  }
+  return false;
+}
+
+// Structural skip of an array/object value: tracks bracket depth and skips
+// strings properly, so unknown-key values containing ']'/'}' inside strings
+// or nested containers don't derail the walk.
+inline bool skip_composite(Cursor& c) {
+  int depth = 0;
+  while (c.p < c.end) {
+    char ch = *c.p;
+    if (ch == '"') {
+      if (!skip_string(c)) return false;
+      continue;
+    }
+    if (ch == '[' || ch == '{') {
+      ++depth;
+    } else if (ch == ']' || ch == '}') {
+      --depth;
+      if (depth == 0) {
+        ++c.p;
+        return true;
+      }
+      if (depth < 0) return false;
+    }
+    ++c.p;
+  }
+  return false;
+}
+
+// Generic value skip for keys we don't extract.
+inline bool skip_value(Cursor& c) {
+  skip_ws(c);
+  if (c.p >= c.end) return false;
+  char ch = *c.p;
+  if (ch == '"') return skip_string(c);
+  if (ch == '[' || ch == '{') return skip_composite(c);
+  // number / true / false / null: scan to the next separator
+  while (c.p < c.end && *c.p != ',' && *c.p != '}') ++c.p;
+  return true;
+}
+
+// Parse one line into output row i (xi zeroed here).
+inline void parse_one_line(const char* p, const char* line_end, int dim,
+                           float* xi, float* yi, unsigned char* opi,
+                           unsigned char* validi) {
+  memset(xi, 0, sizeof(float) * dim);
+  *yi = 0.0f;
+  *opi = 0;
+  *validi = 0;
+
+  const char* q = p;
+  while (q < line_end && isspace(static_cast<unsigned char>(*q))) ++q;
+  long ll = line_end - q;
+  if (ll == 0) return;                                            // blank
+  if ((ll == 3 && strncmp(q, "EOS", 3) == 0) ||
+      (ll == 5 && strncmp(q, "\"EOS\"", 5) == 0))
+    return;                                                       // EOS
+  if (*q != '{') return;                                          // garbage
+
+  Cursor c{q + 1, line_end};
+  // value cursors recorded during the walk; arrays parsed afterwards so
+  // numerical always packs before discrete regardless of key order in the
+  // line (DataPointParser.scala:20-33 ordering)
+  Cursor num_c{nullptr, line_end}, disc_c{nullptr, line_end};
+  bool ok = true, any = false;
+  bool have_target = false, have_op = false;
+  double target = 0.0;
+  int op_val = -1;
+
+  while (ok && c.p < c.end) {
+    skip_ws(c);
+    if (c.p < c.end && (*c.p == ',' )) {
+      ++c.p;
+      continue;
+    }
+    if (c.p < c.end && *c.p == '}') break;
+    if (c.p >= c.end || *c.p != '"') {
+      ok = false;
+      break;
+    }
+    const char* ks = c.p + 1;
+    if (!skip_string(c)) {
+      ok = false;
+      break;
+    }
+    const char* ke = c.p - 1;  // closing quote
+    skip_ws(c);
+    if (c.p >= c.end || *c.p != ':') {
+      ok = false;
+      break;
+    }
+    ++c.p;
+    skip_ws(c);
+    switch (match_key(ks, ke - ks)) {
+      case KEY_CATEGORICAL:
+      case KEY_METADATA:
+        *validi = 2;  // python fallback (hashing / nesting)
+        return;
+      case KEY_NUMERICAL:
+        num_c.p = c.p;
+        if (!skip_value(c)) ok = false;
+        break;
+      case KEY_DISCRETE:
+        disc_c.p = c.p;
+        if (!skip_value(c)) ok = false;
+        break;
+      case KEY_TARGET: {
+        Cursor t{c.p, line_end};
+        if (parse_number(t, &target)) {
+          have_target = true;
+          c.p = t.p;
+        } else {
+          ok = false;  // non-numeric target: Jackson-parity drop
+        }
+        break;
+      }
+      case KEY_OPERATION: {
+        have_op = true;
+        if (c.p + 9 <= line_end && strncmp(c.p, "\"forecast", 9) == 0) {
+          op_val = 1;
+        } else if (c.p + 9 <= line_end &&
+                   strncmp(c.p, "\"training", 9) == 0) {
+          op_val = 0;
+        }
+        if (!skip_value(c)) ok = false;
+        break;
+      }
+      case KEY_UNKNOWN:
+        if (!skip_value(c)) ok = false;
+        break;
     }
   }
-  return nullptr;
+  if (!ok) return;
+
+  int pos = 0;
+  if (num_c.p) {
+    int cnt = 0;
+    if (parse_num_array(num_c, xi, dim, &cnt)) {
+      pos = cnt;
+      any = any || cnt > 0;
+    } else {
+      return;  // malformed / non-numeric array: drop
+    }
+  }
+  if (disc_c.p) {
+    int cnt = 0;
+    if (parse_num_array(disc_c, xi + pos, dim - pos, &cnt)) {
+      any = any || cnt > 0;
+    } else {
+      return;
+    }
+  }
+  if (have_target) *yi = static_cast<float>(target);
+  if (have_op) {
+    if (op_val < 0) return;  // unknown operation: drop
+    *opi = static_cast<unsigned char>(op_val);
+  }
+  *validi = any ? 1 : 0;
 }
 
 }  // namespace
@@ -108,83 +433,60 @@ int omldm_parse_lines(const char* buf, long len, int dim, int max_records,
   while (p < bufend && i < max_records) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
     const char* line_end = nl ? nl : bufend;
-
-    float* xi = x + static_cast<long>(i) * dim;
-    memset(xi, 0, sizeof(float) * dim);
-    y[i] = 0.0f;
-    op[i] = 0;
-    valid[i] = 0;
-
-    // default outcome computed below; blank lines / EOS markers drop
-    const char* q = p;
-    while (q < line_end && isspace(static_cast<unsigned char>(*q))) ++q;
-    long ll = line_end - q;
-    bool blank = (ll == 0);
-    bool eos = (ll == 3 && strncmp(q, "EOS", 3) == 0) ||
-               (ll == 5 && strncmp(q, "\"EOS\"", 5) == 0);
-    if (!blank && !eos) {
-      // categorical features / metadata need the Python path (hashing,
-      // arbitrary nesting)
-      if (find_key(q, line_end, "categoricalFeatures") ||
-          find_key(q, line_end, "metadata")) {
-        valid[i] = 2;
-      } else {
-        int pos = 0;
-        bool ok = true, any = false;
-        const char* v = find_key(q, line_end, "numericalFeatures");
-        if (v) {
-          Cursor c{v, line_end};
-          skip_ws(c);
-          int cnt = 0;
-          if (parse_num_array(c, xi, dim, &cnt)) {
-            pos = cnt;
-            any = any || cnt > 0;
-          } else {
-            ok = false;
-          }
-        }
-        v = ok ? find_key(q, line_end, "discreteFeatures") : nullptr;
-        if (v) {
-          Cursor c{v, line_end};
-          skip_ws(c);
-          int cnt = 0;
-          if (parse_num_array(c, xi + pos, dim - pos, &cnt)) {
-            any = any || cnt > 0;
-          } else {
-            ok = false;
-          }
-        }
-        v = ok ? find_key(q, line_end, "target") : nullptr;
-        if (v) {
-          Cursor c{v, line_end};
-          skip_ws(c);
-          double t;
-          if (parse_number(c, &t)) {
-            y[i] = static_cast<float>(t);
-          } else {
-            ok = false;  // non-numeric target: Jackson-parity drop
-            any = false;
-          }
-        }
-        v = find_key(q, line_end, "operation");
-        if (v) {
-          Cursor c{v, line_end};
-          skip_ws(c);
-          if (c.p + 9 <= line_end && strncmp(c.p, "\"forecast", 9) == 0) {
-            op[i] = 1;
-          } else if (c.p + 9 <= line_end && strncmp(c.p, "\"training", 9) == 0) {
-            op[i] = 0;
-          } else {
-            any = false;  // unknown operation: drop
-          }
-        }
-        valid[i] = (ok && any) ? 1 : 0;
-      }
-    }
+    parse_one_line(p, line_end, dim, x + static_cast<long>(i) * dim, y + i,
+                   op + i, valid + i);
     ++i;
     p = nl ? nl + 1 : bufend;
   }
   return i;
+}
+
+int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,
+                         float* x, float* y, unsigned char* op,
+                         unsigned char* valid, int n_threads) {
+  // index line starts (single memchr sweep; never the bottleneck)
+  std::vector<long> starts;
+  starts.reserve(4096);
+  const char* p = buf;
+  const char* bufend = buf + len;
+  while (p < bufend && static_cast<int>(starts.size()) < max_records) {
+    starts.push_back(p - buf);
+    const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
+    p = nl ? nl + 1 : bufend;
+  }
+  int n = static_cast<int>(starts.size());
+  if (n == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+
+  auto worker = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const char* line = buf + starts[i];
+      // starts[i+1]-1 lands on the '\n'; the final line may lack one
+      long line_len = ((i + 1 < n) ? starts[i + 1] - 1 : len) - starts[i];
+      if (line_len < 0) line_len = 0;
+      const char* line_end = line + line_len;
+      if (line_end > bufend) line_end = bufend;
+      if (line_end > line && line_end[-1] == '\n') --line_end;
+      parse_one_line(line, line_end, dim, x + static_cast<long>(i) * dim,
+                     y + i, op + i, valid + i);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    int chunk = (n + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int lo = t * chunk;
+      int hi = lo + chunk < n ? lo + chunk : n;
+      if (lo >= hi) break;
+      threads.emplace_back(worker, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return n;
 }
 
 }  // extern "C"

@@ -25,7 +25,10 @@ def _build() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH) or (
         os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
     ):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-pthread",
+            "-o", _LIB_PATH, _SRC,
+        ]
         try:
             subprocess.run(
                 cmd, check=True, capture_output=True, text=True, timeout=120
@@ -34,8 +37,7 @@ def _build() -> Optional[ctypes.CDLL]:
             _build_failed = True
             return None
     lib = ctypes.CDLL(_LIB_PATH)
-    lib.omldm_parse_lines.restype = ctypes.c_int
-    lib.omldm_parse_lines.argtypes = [
+    base_argtypes = [
         ctypes.c_char_p,
         ctypes.c_long,
         ctypes.c_int,
@@ -45,6 +47,10 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_ubyte),
         ctypes.POINTER(ctypes.c_ubyte),
     ]
+    lib.omldm_parse_lines.restype = ctypes.c_int
+    lib.omldm_parse_lines.argtypes = base_argtypes
+    lib.omldm_parse_lines_mt.restype = ctypes.c_int
+    lib.omldm_parse_lines_mt.argtypes = base_argtypes + [ctypes.c_int]
     return lib
 
 
@@ -66,10 +72,17 @@ class FastParser:
 
     ``valid`` semantics (see fastparse.cpp): 1 = parsed, 0 = dropped,
     2 = needs the Python fallback (categorical features / metadata);
-    callers reparse flagged lines with ``DataInstance.from_json``."""
+    callers reparse flagged lines with ``DataInstance.from_json``.
 
-    def __init__(self, dim: int):
+    ``n_threads`` > 1 uses the multithreaded C entry (disjoint line ranges
+    per std::thread; ctypes releases the GIL for the call's duration, so a
+    prefetch thread parsing blocks overlaps the device feed)."""
+
+    def __init__(self, dim: int, n_threads: int = 0):
         self.dim = dim
+        if n_threads <= 0:
+            n_threads = min(os.cpu_count() or 1, 8)
+        self.n_threads = n_threads
         lib = _get_lib()
         if lib is None:
             raise RuntimeError("native fast parser unavailable (g++ build failed)")
@@ -84,7 +97,7 @@ class FastParser:
         y = np.zeros((n_lines,), np.float32)
         op = np.zeros((n_lines,), np.uint8)
         valid = np.zeros((n_lines,), np.uint8)
-        consumed = self._lib.omldm_parse_lines(
+        args = (
             data,
             len(data),
             self.dim,
@@ -94,4 +107,8 @@ class FastParser:
             op.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
             valid.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
         )
+        if self.n_threads > 1:
+            consumed = self._lib.omldm_parse_lines_mt(*args, self.n_threads)
+        else:
+            consumed = self._lib.omldm_parse_lines(*args)
         return x[:consumed], y[:consumed], op[:consumed], valid[:consumed]

@@ -10,7 +10,9 @@ hub's pre-creation message cache.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Generic, Iterable, List, Optional, TypeVar
+from typing import Deque, Generic, Iterable, List, Optional, Tuple, TypeVar
+
+import numpy as np
 
 T = TypeVar("T")
 
@@ -56,3 +58,79 @@ class DataSet(Generic[T]):
 
     def to_list(self) -> List[T]:
         return list(self._buf)
+
+
+class ArrayHoldout:
+    """Vectorized bounded FIFO of (x, y) rows — the bulk-ingest counterpart
+    of ``DataSet`` for holdout test sets (FlinkSpoke.scala:94-104 semantics:
+    append evicts the oldest once full; evicted points re-enter training).
+
+    Stored as numpy ring buffers so a block of rows appends without a
+    per-record Python loop; ``append_many`` reports each evicted row and the
+    index (into the incoming block) of the row that evicted it."""
+
+    def __init__(self, max_size: int, dim: int):
+        self.max_size = max_size
+        self._x = np.zeros((max_size, dim), np.float32)
+        self._y = np.zeros((max_size,), np.float32)
+        self._n = 0
+        self._head = 0  # oldest element
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def is_empty(self) -> bool:
+        return self._n == 0
+
+    def append_many(
+        self, xs: np.ndarray, ys: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """FIFO-append a block; returns (evicted_x, evicted_y, evictor_idx)
+        where evictor_idx[i] is the row index within ``xs`` whose arrival
+        evicted evicted_x[i] (exact DataSet.append-loop parity)."""
+        out_x: List[np.ndarray] = []
+        out_y: List[np.ndarray] = []
+        out_src: List[np.ndarray] = []
+        cap = self.max_size
+        # chunks of <= cap keep scatter positions distinct within a chunk
+        for s in range(0, xs.shape[0], cap):
+            cx = xs[s : s + cap]
+            cy = ys[s : s + cap]
+            k = cx.shape[0]
+            fill = min(cap - self._n, k)
+            if fill > 0:
+                pos = (self._head + self._n + np.arange(fill)) % cap
+                self._x[pos] = cx[:fill]
+                self._y[pos] = cy[:fill]
+                self._n += fill
+            k2 = k - fill
+            if k2 > 0:
+                pos = (self._head + np.arange(k2)) % cap
+                out_x.append(self._x[pos].copy())
+                out_y.append(self._y[pos].copy())
+                out_src.append(np.arange(s + fill, s + k))
+                self._x[pos] = cx[fill:]
+                self._y[pos] = cy[fill:]
+                self._head = (self._head + k2) % cap
+        if not out_x:
+            d = xs.shape[1] if xs.ndim == 2 else self._x.shape[1]
+            return (
+                np.zeros((0, d), np.float32),
+                np.zeros((0,), np.float32),
+                np.zeros((0,), np.int64),
+            )
+        return (
+            np.concatenate(out_x),
+            np.concatenate(out_y),
+            np.concatenate(out_src),
+        )
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Contents oldest-to-newest as (x [n, D], y [n]) views (copies)."""
+        idx = (self._head + np.arange(self._n)) % self.max_size
+        return self._x[idx], self._y[idx]
+
+    def clear(self) -> None:
+        self._n = 0
+        self._head = 0

@@ -1,25 +1,37 @@
 """Bulk ingest through the native parser, with Python fallback.
 
 Replaces the per-record Python JSON path for file replay / bulk feeds: the
-C++ parser packs records straight into batch arrays; lines it flags
-(categorical features, metadata, odd schemas) are reparsed with the Python
-``DataInstance`` codec so drop/keep semantics match exactly.
+C++ parser (multithreaded, GIL-released) packs records straight into batch
+arrays; lines it flags (categorical features, metadata, odd schemas) are
+reparsed with the Python ``DataInstance`` codec so drop/keep semantics match
+exactly. Everything after the parse is vectorized numpy — no per-record
+Python object is ever built for fast-schema records.
+
+Reference counterpart: DataInstanceParser + DataPointParser (reference:
+src/main/scala/omldm/utils/parsers/DataInstanceParser.scala:12-22,
+dataStream/DataPointParser.scala:16-54) — the per-record Jackson hot path,
+rebuilt as a block parser so one host core can saturate a TPU chip's input.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from omldm_tpu.api.data import FORECASTING, DataInstance
 from omldm_tpu.runtime.vectorizer import Vectorizer
 
+Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
 
 class PackedBatcher:
-    def __init__(self, dim: int, batch_size: int, hash_dims: int = 0):
+    def __init__(
+        self, dim: int, batch_size: int, hash_dims: int = 0, n_threads: int = 0
+    ):
         self.dim = dim
         self.batch_size = batch_size
+        self.hash_dims = hash_dims
         self.vec = Vectorizer(dim, hash_dims)
         try:
             from omldm_tpu.ops.native import FastParser
@@ -27,78 +39,107 @@ class PackedBatcher:
             # the C parser packs dense features only; cap it at the dense
             # budget so the trailing hash_dims slots (reserved for hashed
             # categoricals) stay zero, matching the Vectorizer layout
-            self.parser: Optional[object] = FastParser(dim - hash_dims)
+            self.parser: Optional[object] = FastParser(
+                dim - hash_dims, n_threads
+            )
         except (RuntimeError, ImportError):
             self.parser = None
-        self._x = np.zeros((batch_size, dim), np.float32)
-        self._y = np.zeros((batch_size,), np.float32)
-        self._op = np.zeros((batch_size,), np.uint8)
-        self._n = 0
+        # ragged tail carried between feed() calls (always < batch_size rows)
+        self._carry_x = np.zeros((0, dim), np.float32)
+        self._carry_y = np.zeros((0,), np.float32)
+        self._carry_op = np.zeros((0,), np.uint8)
 
-    def _emit(self):
-        out = (
-            self._x[: self._n].copy(),
-            self._y[: self._n].copy(),
-            self._op[: self._n].copy(),
-        )
-        self._n = 0
-        return out
-
-    def _push(self, x_row, y_val, op_val):
-        w = x_row.shape[0]
-        self._x[self._n, :w] = x_row
-        self._x[self._n, w:] = 0.0
-        self._y[self._n] = y_val
-        self._op[self._n] = op_val
-        self._n += 1
-        if self._n >= self.batch_size:
-            return self._emit()
-        return None
-
-    def feed(self, block: bytes):
-        """Consume a byte block of whole JSON lines; yields full batches."""
-        if self.parser is not None:
-            x, y, op, valid = self.parser.parse(block)
-            lines = None
-            for i in range(x.shape[0]):
-                if valid[i] == 1:
-                    out = self._push(x[i], y[i], op[i])
-                    if out:
-                        yield out
-                elif valid[i] == 2:
-                    if lines is None:
-                        lines = block.split(b"\n")
-                    out = self._push_python(lines[i])
-                    if out:
-                        yield out
+    def _parse_block(
+        self, block: bytes
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One block of whole JSON lines -> kept (x[., dim], y, op) rows."""
+        if self.parser is None:
+            return self._parse_block_python(block)
+        x, y, op, valid = self.parser.parse(block)
+        if self.hash_dims > 0:
+            out = np.zeros((x.shape[0], self.dim), np.float32)
+            out[:, : x.shape[1]] = x
         else:
-            for line in block.split(b"\n"):
-                out = self._push_python(line)
-                if out:
-                    yield out
+            out = x
+        fallback = np.nonzero(valid == 2)[0]
+        if fallback.size:
+            lines = block.split(b"\n")
+            for i in fallback:
+                inst = DataInstance.from_json(
+                    lines[i].decode("utf-8", errors="replace")
+                )
+                if inst is None:
+                    valid[i] = 0
+                    continue
+                out[i] = self.vec.vectorize(inst)
+                y[i] = 0.0 if inst.target is None else inst.target
+                op[i] = 1 if inst.operation == FORECASTING else 0
+                valid[i] = 1
+        keep = valid == 1
+        if keep.all():
+            return out, y, op
+        return out[keep], y[keep], op[keep]
 
-    def _push_python(self, line: bytes):
-        inst = DataInstance.from_json(line.decode("utf-8", errors="replace"))
-        if inst is None:
-            return None
-        return self._push(
-            self.vec.vectorize(inst),
-            0.0 if inst.target is None else inst.target,
-            1 if inst.operation == FORECASTING else 0,
+    def _parse_block_python(
+        self, block: bytes
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rows_x: List[np.ndarray] = []
+        rows_y: List[float] = []
+        rows_op: List[int] = []
+        for line in block.split(b"\n"):
+            inst = DataInstance.from_json(line.decode("utf-8", errors="replace"))
+            if inst is None:
+                continue
+            rows_x.append(self.vec.vectorize(inst))
+            rows_y.append(0.0 if inst.target is None else inst.target)
+            rows_op.append(1 if inst.operation == FORECASTING else 0)
+        if not rows_x:
+            return (
+                np.zeros((0, self.dim), np.float32),
+                np.zeros((0,), np.float32),
+                np.zeros((0,), np.uint8),
+            )
+        return (
+            np.stack(rows_x),
+            np.asarray(rows_y, np.float32),
+            np.asarray(rows_op, np.uint8),
         )
 
-    def flush(self):
-        if self._n:
-            return self._emit()
-        return None
+    def feed(self, block: bytes) -> Iterator[Batch]:
+        """Consume a byte block of whole JSON lines; yields full batches."""
+        x, y, op = self._parse_block(block)
+        if x.shape[0] == 0:
+            return
+        if self._carry_x.shape[0]:
+            x = np.concatenate([self._carry_x, x])
+            y = np.concatenate([self._carry_y, y])
+            op = np.concatenate([self._carry_op, op])
+        n = x.shape[0]
+        b = self.batch_size
+        full = (n // b) * b
+        for i in range(0, full, b):
+            yield x[i : i + b], y[i : i + b], op[i : i + b]
+        # copy the tail so the big concatenated block can be freed
+        self._carry_x = x[full:].copy()
+        self._carry_y = y[full:].copy()
+        self._carry_op = op[full:].copy()
+
+    def flush(self) -> Optional[Batch]:
+        if self._carry_x.shape[0] == 0:
+            return None
+        out = (self._carry_x, self._carry_y, self._carry_op)
+        self._carry_x = np.zeros((0, self.dim), np.float32)
+        self._carry_y = np.zeros((0,), np.float32)
+        self._carry_op = np.zeros((0,), np.uint8)
+        return out
 
 
 def iter_file_batches(
     path: str, dim: int, batch_size: int, hash_dims: int = 0,
-    chunk_bytes: int = 1 << 22,
-) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    chunk_bytes: int = 1 << 22, n_threads: int = 0,
+) -> Iterator[Batch]:
     """Stream a JSON-lines file as packed (x, y, op) batches."""
-    b = PackedBatcher(dim, batch_size, hash_dims)
+    b = PackedBatcher(dim, batch_size, hash_dims, n_threads)
     with open(path, "rb") as f:
         leftover = b""
         while True:

@@ -35,6 +35,9 @@ from omldm_tpu.runtime.vectorizer import Vectorizer
 TRAINING_STREAM = "trainingData"
 FORECASTING_STREAM = "forecastingData"
 REQUEST_STREAM = "requests"
+# pseudo-stream carrying pre-vectorized (x, y, op) blocks from the C++
+# bulk-ingest path (runtime.fast_ingest); replaces per-record JSON events
+PACKED_STREAM = "__packed__"
 
 
 class StreamJob:
@@ -147,6 +150,8 @@ class StreamJob:
                 if stream == FORECASTING_STREAM:
                     inst.operation = FORECASTING
                 self._handle_data(inst)
+        elif stream == PACKED_STREAM:
+            self.process_packed_batch(*payload)
 
     def _handle_request(self, request: Request) -> None:
         self.stats.mark_activity()
@@ -199,6 +204,10 @@ class StreamJob:
         for spoke in self.spokes:
             for inst in spoke.record_buffer:
                 return Vectorizer.infer_dim(inst, hash_dims)
+            packed_dim = spoke.buffered_packed_dim()
+            if packed_dim is not None:
+                # packed rows already include any hashed-categorical region
+                return packed_dim
         return None
 
     def _request_dim(self, request: Request) -> Optional[int]:
@@ -264,6 +273,33 @@ class StreamJob:
         # across its mesh worker slots internally)
         for bridge in self.spmd_bridges.values():
             bridge.handle_data(inst)
+
+    def process_packed_batch(
+        self, x: "np.ndarray", y: "np.ndarray", op: "np.ndarray"
+    ) -> None:
+        """Bulk data path: pre-vectorized rows from the C++ ingest parser
+        (runtime.fast_ingest.PackedBatcher). Rows are distributed exactly as
+        per-record events would be: a strided round-robin share per host
+        spoke (continuing the _rr cycle, so packed and per-record events can
+        interleave) and every row to every SPMD-engine bridge."""
+        n = x.shape[0]
+        if n == 0 or self.stats.terminated:
+            return
+        self.stats.mark_activity()
+        if self._pending_creates:
+            pending, self._pending_creates = self._pending_creates, []
+            for request in pending:
+                self._deploy(request, int(x.shape[1]))
+        p = len(self.spokes)
+        for w in range(p):
+            start = (w - self._rr) % p
+            if start < n:
+                self.spokes[w].handle_packed(
+                    x[start::p], y[start::p], op[start::p]
+                )
+        self._rr += n
+        for bridge in self.spmd_bridges.values():
+            bridge.handle_batch(x, y, op)
 
     # --- run loops ---
 

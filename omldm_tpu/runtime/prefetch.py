@@ -1,0 +1,60 @@
+"""Background prefetch for ingest iterators.
+
+Host→device double buffering, stage one: a daemon thread drains the source
+iterator (file read + C++ parse, which releases the GIL) into a small
+bounded queue while the consumer feeds the device. With the parse and the
+device step overlapped, pipeline throughput is max(parse, step) instead of
+their sum — the reference gets the same overlap from Flink's network stack
+running ahead of the operator thread (SURVEY.md §7 hard part (d)).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+def prefetch(source: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Iterate ``source`` on a daemon thread, ``depth`` items ahead.
+
+    Exceptions raised by the source are re-raised at the consumption point;
+    abandoning the iterator (break / GC) stops the thread at its next put.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    stop = threading.Event()
+
+    def run() -> None:
+        try:
+            for item in source:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_SENTINEL)
+        except BaseException as e:  # propagate to the consumer
+            try:
+                q.put(e)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

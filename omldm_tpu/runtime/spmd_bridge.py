@@ -28,7 +28,7 @@ from omldm_tpu.api.stats import Statistics
 from omldm_tpu.config import JobConfig
 from omldm_tpu.parallel.mesh import make_mesh
 from omldm_tpu.parallel.spmd import SPMD_PROTOCOLS, SPMDTrainer
-from omldm_tpu.runtime.databuffers import DataSet
+from omldm_tpu.runtime.databuffers import ArrayHoldout
 from omldm_tpu.runtime.spoke import PREDICT_BATCH
 from omldm_tpu.runtime.vectorizer import Vectorizer
 
@@ -84,13 +84,17 @@ class SPMDBridge:
         hash_dims = int(tc.extra.get("hashDims", 0))
         self.vectorizer = Vectorizer(dim, hash_dims)
         self.dim = dim
-        self.test_set: DataSet[Tuple[np.ndarray, float]] = DataSet(
-            config.test_set_size
-        )
+        self.test_set = ArrayHoldout(config.test_set_size, dim)
         self.holdout_count = 0
-        # staged rows round-robined across the dp worker slots
-        self._rows_x: List[np.ndarray] = []
-        self._rows_y: List[float] = []
+        # staged rows fill a [chain * dp * B, D] buffer; a full buffer is
+        # one chained step_many launch (amortizes dispatch — the per-launch
+        # cost dominates through the TPU tunnel and is real on any host)
+        self.chain = max(int(tc.extra.get("stageChain", 8)), 1)
+        b = config.batch_size
+        self._stage_cap = self.chain * dp * b
+        self._stage_x = np.zeros((self._stage_cap, dim), np.float32)
+        self._stage_y = np.zeros((self._stage_cap,), np.float32)
+        self._stage_n = 0
 
     # --- data path ---
 
@@ -109,36 +113,131 @@ class SPMDBridge:
         c = self.holdout_count % 10
         self.holdout_count += 1
         if self.config.test and c >= 8:
-            evicted = self.test_set.append((x, y))
-            if evicted is None:
+            ev_x, ev_y, _ = self.test_set.append_many(
+                x[None, :], np.asarray([y], np.float32)
+            )
+            if ev_x.shape[0] == 0:
                 return
-            x, y = evicted
-        self._rows_x.append(x)
-        self._rows_y.append(y)
-        if len(self._rows_x) >= self.dp * self.config.batch_size:
-            self._train_staged()
+            x, y = ev_x[0], float(ev_y[0])
+        self._stage_rows(x[None, :], np.asarray([y], np.float32))
 
-    def _train_staged(self) -> None:
-        """Train the staged rows as one [dp, B, D] fleet step (padded with
-        a zero mask when the stage is partial)."""
-        n = len(self._rows_x)
+    def handle_batch(
+        self, x: np.ndarray, y: np.ndarray, op: np.ndarray
+    ) -> None:
+        """Bulk equivalent of handle_data for pre-vectorized rows (the C++
+        ingest path): same holdout cycle and staging order as feeding the
+        rows one at a time, but vectorized end to end."""
+        n = x.shape[0]
+        if n == 0:
+            return
+        if x.shape[1] != self.dim:
+            w = min(x.shape[1], self.dim)
+            out = np.zeros((n, self.dim), np.float32)
+            out[:, :w] = x[:, :w]
+            x = out
+        f_idx = np.nonzero(op != 0)[0]
+        if f_idx.size:
+            # serve each forecast at its stream position (train the rows
+            # before it first) so packed ordering matches per-record
+            prev = 0
+            for f in f_idx:
+                f = int(f)
+                if f > prev:
+                    self._train_rows(x[prev:f], y[prev:f])
+                xb = np.zeros((PREDICT_BATCH, self.dim), np.float32)
+                xb[0] = x[f]
+                preds = self.trainer.predict(xb)
+                inst = DataInstance(
+                    numerical_features=x[f].tolist(),
+                    operation=FORECASTING,
+                )
+                self._emit_prediction(
+                    Prediction(self.request.id, inst, float(preds[0]))
+                )
+                prev = f + 1
+            if prev < n:
+                self._train_rows(x[prev:], y[prev:])
+            return
+        self._train_rows(x, y)
+
+    def _train_rows(self, x: np.ndarray, y: np.ndarray) -> None:
+        """Holdout-split a run of training rows, then stage them."""
+        n = x.shape[0]
+        if n == 0:
+            return
+        if self.config.test:
+            c = (self.holdout_count + np.arange(n)) % 10
+            self.holdout_count += n
+            test_mask = c >= 8
+            keep_idx = np.nonzero(~test_mask)[0]
+            t_idx = np.nonzero(test_mask)[0]
+            ev_x, ev_y, ev_src = self.test_set.append_many(x[t_idx], y[t_idx])
+            if ev_src.size:
+                # evicted points re-enter training at the evicting row's slot
+                pos = np.concatenate([keep_idx, t_idx[ev_src]])
+                order = np.argsort(pos, kind="stable")
+                x = np.concatenate([x[keep_idx], ev_x])[order]
+                y = np.concatenate([y[keep_idx], ev_y])[order]
+            else:
+                x = x[keep_idx]
+                y = y[keep_idx]
+        else:
+            self.holdout_count += n
+        self._stage_rows(x, y)
+
+    def _stage_rows(self, x: np.ndarray, y: np.ndarray) -> None:
+        i = 0
+        n = x.shape[0]
+        while i < n:
+            take = min(self._stage_cap - self._stage_n, n - i)
+            self._stage_x[self._stage_n : self._stage_n + take] = x[i : i + take]
+            self._stage_y[self._stage_n : self._stage_n + take] = y[i : i + take]
+            self._stage_n += take
+            i += take
+            if self._stage_n >= self._stage_cap:
+                self._train_staged(full=True)
+
+    def _train_staged(self, full: bool = False) -> None:
+        """Launch the staged rows: a full stage is one chained step_many of
+        ``chain`` [dp, B, D] steps; a partial stage (flush) runs whole
+        [dp, B] groups as single steps and pads the remainder with a zero
+        mask."""
+        n = self._stage_n
         if n == 0:
             return
         b = self.config.batch_size
-        total = self.dp * b
-        x = np.zeros((total, self.dim), np.float32)
-        y = np.zeros((total,), np.float32)
-        mask = np.zeros((total,), np.float32)
-        x[:n] = np.stack(self._rows_x)
-        y[:n] = np.asarray(self._rows_y, np.float32)
-        mask[:n] = 1.0
-        self._rows_x, self._rows_y = [], []
-        self.trainer.step(
-            x.reshape(self.dp, b, self.dim),
-            y.reshape(self.dp, b),
-            mask.reshape(self.dp, b),
-            valid_count=n,
-        )
+        group = self.dp * b
+        if full and self.chain > 1:
+            xs = self._stage_x.reshape(self.chain, self.dp, b, self.dim)
+            ys = self._stage_y.reshape(self.chain, self.dp, b)
+            masks = np.ones((self.chain, self.dp, b), np.float32)
+            self.trainer.step_many(xs, ys, masks)
+            self._stage_n = 0
+            return
+        done = 0
+        while n - done >= group:
+            self.trainer.step(
+                self._stage_x[done : done + group].reshape(self.dp, b, self.dim),
+                self._stage_y[done : done + group].reshape(self.dp, b),
+                np.ones((self.dp, b), np.float32),
+                valid_count=group,
+            )
+            done += group
+        rem = n - done
+        if rem > 0:
+            x = np.zeros((group, self.dim), np.float32)
+            y = np.zeros((group,), np.float32)
+            mask = np.zeros((group,), np.float32)
+            x[:rem] = self._stage_x[done:n]
+            y[:rem] = self._stage_y[done:n]
+            mask[:rem] = 1.0
+            self.trainer.step(
+                x.reshape(self.dp, b, self.dim),
+                y.reshape(self.dp, b),
+                mask.reshape(self.dp, b),
+                valid_count=rem,
+            )
+        self._stage_n = 0
 
     def flush(self) -> None:
         self._train_staged()
@@ -148,8 +247,7 @@ class SPMDBridge:
     def _evaluate(self) -> Tuple[float, float]:
         if self.test_set.is_empty:
             return 0.0, 0.0
-        xs = np.stack([p[0] for p in self.test_set])
-        ys = np.asarray([p[1] for p in self.test_set], np.float32)
+        xs, ys = self.test_set.arrays()
         return self.trainer.evaluate(xs, ys, np.ones(len(ys), np.float32))
 
     def emit_query_response(self, response_id: int) -> None:
@@ -224,6 +322,6 @@ class SPMDBridge:
             fitted=self.trainer.fitted,
             learning_curve=[l for l, _ in curve],
             lcx=[f for _, f in curve],
-            mean_buffer_size=float(len(self._rows_x)),
+            mean_buffer_size=float(self._stage_n),
             score=score,
         )

@@ -115,6 +115,10 @@ class Spoke:
         self._on_poll = on_poll
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
+        # packed-row pre-creation buffer: whole (x, y, op) blocks with the
+        # same total-row cap as the record buffer
+        self._packed_buffer: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._packed_buffered_rows = 0
         self._poll_counter = 0
 
     # --- control path (FlinkSpoke.processElement2) ---
@@ -149,6 +153,11 @@ class Spoke:
             self.record_buffer.clear()
             for inst in buffered:
                 self.handle_data(inst)
+        if self._packed_buffer:
+            blocks, self._packed_buffer = self._packed_buffer, []
+            self._packed_buffered_rows = 0
+            for x, y, op in blocks:
+                self.handle_packed(x, y, op)
 
     def _delete(self, network_id: int) -> None:
         self.nets.pop(network_id, None)
@@ -179,6 +188,140 @@ class Spoke:
             self._poll_counter += 1
             if self.config.test and self._poll_counter % self.config.poll_every == 0:
                 self._on_poll()
+
+    # --- packed data path (bulk ingest; C++ parser -> arrays, no per-record
+    # Python objects; semantics identical to handle_data on the same rows) ---
+
+    def handle_packed(self, x: np.ndarray, y: np.ndarray, op: np.ndarray) -> None:
+        """Bulk equivalent of handle_data for pre-vectorized rows.
+
+        ``x`` [n, W] float32, ``y`` [n] float32, ``op`` [n] uint8
+        (0=training, 1=forecasting). Produces the same per-net state as
+        feeding the rows one at a time (same holdout cycle, same batcher
+        fill order, same poll markers, forecasts served at their stream
+        position); pause (toggle) is honored at block granularity rather
+        than per record, and cross-spoke protocol interleaving is likewise
+        block-granular (the reference's Flink rebalance gives no per-record
+        cross-worker ordering either, FlinkLearning.scala:83-88).
+        """
+        n = x.shape[0]
+        if n == 0:
+            return
+        if not self.nets:
+            # same eviction direction as the per-record DataSet buffer:
+            # keep the NEWEST record_buffer_cap rows (SpokeLogic.scala:31-35)
+            self._packed_buffer.append((x, y, op))
+            self._packed_buffered_rows += n
+            cap = self.config.record_buffer_cap
+            while self._packed_buffered_rows > cap:
+                ox, oy, oop = self._packed_buffer[0]
+                excess = self._packed_buffered_rows - cap
+                if ox.shape[0] <= excess:
+                    self._packed_buffer.pop(0)
+                    self._packed_buffered_rows -= ox.shape[0]
+                else:
+                    self._packed_buffer[0] = (
+                        ox[excess:], oy[excess:], oop[excess:]
+                    )
+                    self._packed_buffered_rows -= excess
+            return
+        f_idx = np.nonzero(op != 0)[0]
+        for net in self.nets.values():
+            if net.node.paused:
+                continue
+            # serve each forecast at its stream position: train the rows
+            # before it, predict, continue — matching per-record ordering
+            prev = 0
+            for f in f_idx:
+                f = int(f)
+                if f > prev:
+                    self._train_packed(net, x[prev:f], y[prev:f])
+                self._serve_packed(net, x, np.asarray([f]))
+                prev = f + 1
+            if prev < n:
+                self._train_packed(net, x[prev:], y[prev:])
+        nt = n - int(f_idx.size)
+        if nt:
+            pc = self._poll_counter
+            self._poll_counter += nt
+            if self.config.test:
+                pe = self.config.poll_every
+                for _ in range(self._poll_counter // pe - pc // pe):
+                    self._on_poll()
+
+    def buffered_packed_dim(self) -> Optional[int]:
+        """Feature width of buffered pre-creation packed rows, if any."""
+        if self._packed_buffer:
+            return int(self._packed_buffer[0][0].shape[1])
+        return None
+
+    def _adapt_width(self, rows: np.ndarray, dim: int) -> np.ndarray:
+        """Pad/truncate packed rows to a net's feature width (nets created
+        with a different dim than the packed stream still train)."""
+        w = rows.shape[1]
+        if w == dim:
+            return rows
+        if w > dim:
+            return rows[:, :dim]
+        out = np.zeros((rows.shape[0], dim), np.float32)
+        out[:, :w] = rows
+        return out
+
+    def _train_packed(self, net: SpokeNet, tx: np.ndarray, ty: np.ndarray) -> None:
+        n = tx.shape[0]
+        if n == 0:
+            return
+        tx = self._adapt_width(tx, net.dim)
+        if self.config.test:
+            # vectorized 8-of-10 holdout split; evicted test points re-enter
+            # the training flow at the slot of the row that evicted them
+            c = (net.holdout_count + np.arange(n)) % 10
+            net.holdout_count += n
+            test_mask = c >= 8
+            keep_idx = np.nonzero(~test_mask)[0]
+            ev_x: List[np.ndarray] = []
+            ev_y: List[float] = []
+            ev_pos: List[int] = []
+            for i in np.nonzero(test_mask)[0]:
+                evicted = net.test_set.append((tx[i].copy(), float(ty[i])))
+                if evicted is not None:
+                    ev_x.append(evicted[0])
+                    ev_y.append(evicted[1])
+                    ev_pos.append(int(i))
+            if ev_pos:
+                pos = np.concatenate([keep_idx, np.asarray(ev_pos)])
+                order = np.argsort(pos, kind="stable")
+                tx = np.concatenate([tx[keep_idx], np.stack(ev_x)])[order]
+                ty = np.concatenate(
+                    [ty[keep_idx], np.asarray(ev_y, np.float32)]
+                )[order]
+            else:
+                tx = tx[keep_idx]
+                ty = ty[keep_idx]
+        i = 0
+        total = tx.shape[0]
+        while i < total:
+            i += net.batcher.add_many(tx[i:], ty[i:])
+            if net.batcher.full:
+                net.flush_batch()
+
+    def _serve_packed(
+        self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
+    ) -> None:
+        rows = self._adapt_width(x[f_idx], net.dim)
+        for s in range(0, f_idx.size, PREDICT_BATCH):
+            chunk = rows[s : s + PREDICT_BATCH]
+            xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+            xb[: chunk.shape[0]] = chunk
+            preds = net.node.on_forecast_batch(xb)
+            for j in range(chunk.shape[0]):
+                inst = DataInstance(
+                    numerical_features=chunk[j].tolist(),
+                    operation=FORECASTING,
+                )
+                self._emit_prediction(
+                    Prediction(net.request.id, inst, float(preds[j]))
+                )
 
     def _train(self, net: SpokeNet, x: np.ndarray, y: float) -> None:
         # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)

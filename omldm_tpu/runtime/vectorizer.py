@@ -87,6 +87,16 @@ class MicroBatcher:
         self._y[self._n] = y
         self._n += 1
 
+    def add_many(self, x: np.ndarray, y: np.ndarray) -> int:
+        """Bulk-add up to the remaining capacity; returns #rows taken.
+        Callers loop: take, flush when full, repeat with the rest."""
+        take = min(self.batch_size - self._n, x.shape[0])
+        if take > 0:
+            self._x[self._n : self._n + take] = x[:take]
+            self._y[self._n : self._n + take] = y[:take]
+            self._n += take
+        return take
+
     def flush(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Return the padded (x, y, mask) batch and reset; None if empty."""
         if self._n == 0:
