@@ -1,77 +1,55 @@
-"""Benchmark: HIGGS-shaped online logistic regression, examples/sec/chip.
+"""Benchmark: end-to-end streaming training throughput (BASELINE.md config 1).
 
-BASELINE.md config 1 ("Online logistic regression, HIGGS binary"): a
-28-feature binary-classification stream through the StandardScaler +
-logistic-regression (Softmax, K=2) pipeline — the same workload the
-reference trains per-record on the JVM (MLPipeline.pipePoint ->
-learner.fit, hs_err_pid77107.log:109-113). Here the whole pipeline step
-(scaler update + transform + LR gradient step + loss) is one jitted XLA
-program consuming fixed-shape micro-batches from host memory (streaming
-ingest modeled by feeding per-step numpy batches).
+The PRIMARY metric is the honest whole-job number: JSON bytes -> trained
+parameters through the real CLI ingest route (C++ block parse -> prefetch
+thread -> packed holdout/staging -> chained SPMD device steps), the same
+path `python -m omldm_tpu --trainingData file.jsonl` takes. This maps to
+the reference's whole-job throughput (Job.scala:42-70 ->
+FlinkSpoke.scala:92-107 per-record hot loop, which it drives at
+parallelism 16 on a 4C/8T workstation, hs_err_pid77107.log:21).
+
+In this environment the TPU sits behind a network tunnel that serializes
+every host->device byte through a remote RPC (~15-20 MB/s effective, vs
+>10 GB/s PCIe/DMA on any real host), so the benchmark decomposes the run
+into three directly-measured components (see
+benchmarks/run_benchmarks.py:bench_e2e_stream):
+
+- raw:    full run including the tunnel (reported as a field);
+- host:   the identical pipeline with the device stubbed (parse ceiling);
+- device: the same chained launches on device-resident stages.
+
+``value`` is the tunnel-corrected figure n / max(t_host, t_device) — the
+pipeline bottleneck once transfers ride PCIe instead of the tunnel; the
+raw and component figures are all reported alongside.
 
 The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
 computed against a 100k examples/sec proxy — a generous estimate of the
-reference's whole-job throughput at parallelism 16 on its 4C/8T workstation
-(hs_err_pid77107.log:21), i.e. vs_baseline = measured / 100_000.
+reference's whole-job throughput at parallelism 16 on its workstation —
+i.e. vs_baseline = value / 100_000.
 """
 
 import json
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
+    from run_benchmarks import bench_e2e_stream
 
-    from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec
-    from omldm_tpu.pipelines import MLPipeline
-
-    dim = 28
-    batch = 4096
-    pipe = MLPipeline(
-        LearnerSpec("Softmax", hyper_parameters={"learningRate": 0.05, "nClasses": 2}),
-        [PreprocessorSpec("StandardScaler")],
-        dim=dim,
-        rng=jax.random.PRNGKey(0),
-    )
-
-    rng = np.random.RandomState(0)
-    w = rng.randn(dim)
-    n_stage = 32  # distinct staged batches cycled to model streaming ingest;
-    # batches are pre-staged on device (double-buffered prefetch): in this
-    # environment the chip sits behind a network tunnel whose host->device
-    # bandwidth would otherwise measure the tunnel, not the framework
-    xs = rng.randn(n_stage, batch, dim).astype(np.float32)
-    ys = (xs @ w > 0).astype(np.float32)
-    masks = np.ones((n_stage, batch), np.float32)
-    counts = masks.sum(axis=1)
-    xs_d, ys_d, masks_d = (jax.device_put(a) for a in (xs, ys, masks))
-
-    # fit_many: the T staged micro-batches train as ONE lax.scan program —
-    # the device never waits on host dispatch between steps (the same chained
-    # path the protocol workers use to drain a training backlog,
-    # WorkerNode.drain_blocked)
-    # warmup / compile
-    pipe.fit_many(xs_d, ys_d, masks_d, valid_counts=counts)
-    jax.block_until_ready(pipe.state["params"])
-
-    rounds = 20
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        pipe.fit_many(xs_d, ys_d, masks_d, valid_counts=counts)
-    jax.block_until_ready(pipe.state["params"])
-    dt = time.perf_counter() - t0
-
-    examples_per_sec = rounds * n_stage * batch / dt
+    _, corrected, extra = bench_e2e_stream(n_records=1_000_000)
     print(
         json.dumps(
             {
-                "metric": "HIGGS-shaped online LR examples/sec/chip",
-                "value": round(examples_per_sec, 1),
+                "metric": (
+                    "e2e streaming train throughput, JSON bytes -> trained "
+                    "params (tunnel-corrected)"
+                ),
+                "value": round(corrected, 1),
                 "unit": "examples/sec",
-                "vs_baseline": round(examples_per_sec / 100_000.0, 3),
+                "vs_baseline": round(corrected / 100_000.0, 3),
+                **extra,
             }
         )
     )

@@ -13,10 +13,13 @@
 // Build: g++ -O3 -shared -fPIC -pthread -o libfastparse.so fastparse.cpp
 //
 // Exposed C ABI:
-//   int omldm_parse_lines(buf, len, dim, max_records, x, y, op, valid)
+//   int omldm_parse_lines(buf, len, dim, max_records, x, y, op, valid,
+//                         bytes_consumed)
 //   int omldm_parse_lines_mt(buf, len, dim, max_records, x, y, op, valid,
-//                            n_threads)
-// Returns the number of lines consumed. For line i:
+//                            n_threads, bytes_consumed)
+// Returns the number of lines consumed and stores the byte offset consumed
+// (so a caller sizing its arrays by estimate can continue from there
+// without pre-counting newlines). For line i:
 //   valid[i] = 1 parsed ok, 0 dropped (invalid/EOS), 2 needs Python fallback
 //   op[i]    = 0 training, 1 forecasting
 //   y[i]     = target (0 when absent); x[i*dim .. i*dim+dim) zero-padded.
@@ -46,24 +49,9 @@ const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
 
 // --- SWAR digit runs -------------------------------------------------------
 
-inline bool all_digits4(uint32_t c) {
-  return ((c & 0xF0F0F0F0u) == 0x30303030u) &&
-         (((c + 0x06060606u) & 0xF0F0F0F0u) == 0x30303030u);
-}
-
-inline bool all_digits8(uint64_t c) {
-  return ((c & 0xF0F0F0F0F0F0F0F0ull) == 0x3030303030303030ull) &&
-         (((c + 0x0606060606060606ull) & 0xF0F0F0F0F0F0F0F0ull) ==
-          0x3030303030303030ull);
-}
-
-// 4 ASCII digits (little-endian load order = text order) -> value.
-inline uint32_t swar4(uint32_t c) {
-  uint32_t t = c & 0x0F0F0F0Fu;
-  t = (t * 10 + (t >> 8)) & 0x00FF00FFu;
-  t = (t * 100 + (t >> 16)) & 0x0000FFFFu;
-  return t;
-}
+const uint64_t kPow10u[] = {1ull,       10ull,       100ull,
+                            1000ull,    10000ull,    100000ull,
+                            1000000ull, 10000000ull, 100000000ull};
 
 // 8 ASCII digits -> value (Lemire's parse_eight_digits).
 inline uint64_t swar8(uint64_t c) {
@@ -76,25 +64,41 @@ inline uint64_t swar8(uint64_t c) {
   return c;
 }
 
-// Accumulate a digit run into mant; returns #digits consumed.
+// Count the leading ASCII-digit bytes of an 8-byte (text-order) load: a
+// byte is a digit iff (c^0x30) <= 9; the +0x76 carry trick sets the high
+// bit of every non-digit byte, ctz finds the first one.
+inline int digit_prefix_len8(uint64_t c8) {
+  uint64_t t = c8 ^ 0x3030303030303030ull;
+  uint64_t nd = ((t + 0x7676767676767676ull) | t) & 0x8080808080808080ull;
+  if (nd == 0) return 8;
+  return static_cast<int>(__builtin_ctzll(nd)) >> 3;
+}
+
+// Accumulate a digit run into mant; returns #digits consumed. One 8-byte
+// load classifies the run head (no all-or-nothing retries): a partial run
+// of n digits is shifted to the tail bytes, the head refilled with ASCII
+// zeros, and folded with the same swar8.
 inline int parse_digit_run(const char*& p, const char* end, uint64_t& mant) {
   int digits = 0;
   while (end - p >= 8) {
     uint64_t c8;
     memcpy(&c8, p, 8);
-    if (!all_digits8(c8)) break;
-    mant = mant * 100000000ull + swar8(c8);
-    digits += 8;
-    p += 8;
-  }
-  if (end - p >= 4) {
-    uint32_t c4;
-    memcpy(&c4, p, 4);
-    if (all_digits4(c4)) {
-      mant = mant * 10000ull + swar4(c4);
-      digits += 4;
-      p += 4;
+    int nd = digit_prefix_len8(c8);
+    if (nd == 8) {
+      mant = mant * 100000000ull + swar8(c8);
+      digits += 8;
+      p += 8;
+      continue;
     }
+    if (nd > 0) {
+      int s = 8 * (8 - nd);  // s in [8, 56]: both shifts below are defined
+      uint64_t shifted =
+          (c8 << s) | (0x3030303030303030ull >> (64 - s));
+      mant = mant * kPow10u[nd] + swar8(shifted);
+      digits += nd;
+      p += nd;
+    }
+    return digits;
   }
   while (p < end && *p >= '0' && *p <= '9') {
     mant = mant * 10ull + static_cast<uint64_t>(*p - '0');
@@ -125,13 +129,50 @@ inline bool parse_number(Cursor& c, double* out) {
     ++p;
   }
   uint64_t mant = 0;
-  int digits = parse_digit_run(p, end, mant);
+  int digits = 0;
   int frac = 0;
+  // One-window fast path for the dominant shape "d.f{1..6}" (one integer
+  // digit, '.' and up to six fraction digits all inside one 8-byte load):
+  // classifies the window once instead of two digit-run calls.
+  if (end - p >= 8) {
+    uint64_t c8;
+    memcpy(&c8, p, 8);
+    uint64_t t = c8 ^ 0x3030303030303030ull;
+    uint64_t nd = ((t + 0x7676767676767676ull) | t) & 0x8080808080808080ull;
+    if ((nd & 0x000000000000FF00ull) && !(nd & 0xFFull) &&
+        ((c8 >> 8) & 0xFFull) == '.') {
+      uint64_t rest = nd >> 16;  // non-digits among fraction bytes 2..7
+      int fr = rest ? static_cast<int>(__builtin_ctzll(rest)) >> 3 : 6;
+      bool full_window = (fr == 6);
+      // a full window might truncate a longer fraction: only take the fast
+      // path when the byte after the window cannot extend the number
+      if (!full_window ||
+          (end - p > 8 && !(p[8] >= '0' && p[8] <= '9') && p[8] != '.') ||
+          end - p == 8) {
+        uint64_t d0 = c8 & 0x0Full;
+        if (fr > 0) {
+          int s = 8 * (8 - fr);
+          uint64_t shifted =
+              ((c8 >> 16) << s) | (0x3030303030303030ull >> (64 - s));
+          mant = d0 * kPow10u[fr] + swar8(shifted);
+        } else {
+          mant = d0;
+        }
+        digits = 1 + fr;
+        frac = fr;
+        p += 2 + fr;
+        goto have_mantissa;
+      }
+    }
+  }
+  digits = parse_digit_run(p, end, mant);
+  frac = 0;
   if (p < end && *p == '.') {
     ++p;
     frac = parse_digit_run(p, end, mant);
     digits += frac;
   }
+have_mantissa:;
   if (digits == 0 || digits > 19) {
     // empty ("-", ".") or precision/overflow-risky: defer to strtod
     char* endp = nullptr;
@@ -311,7 +352,9 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   *validi = 0;
 
   const char* q = p;
-  while (q < line_end && isspace(static_cast<unsigned char>(*q))) ++q;
+  while (q < line_end &&
+         (*q == ' ' || *q == '\t' || *q == '\r' || *q == '\f' || *q == '\v'))
+    ++q;
   long ll = line_end - q;
   if (ll == 0) return;                                            // blank
   if ((ll == 3 && strncmp(q, "EOS", 3) == 0) ||
@@ -320,14 +363,20 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   if (*q != '{') return;                                          // garbage
 
   Cursor c{q + 1, line_end};
-  // value cursors recorded during the walk; arrays parsed afterwards so
-  // numerical always packs before discrete regardless of key order in the
-  // line (DataPointParser.scala:20-33 ordering)
-  Cursor num_c{nullptr, line_end}, disc_c{nullptr, line_end};
-  bool ok = true, any = false;
+  // numerical parses INLINE into xi[0..] during the walk (it always packs
+  // first, DataPointParser.scala:20-33 ordering); discrete parses inline at
+  // xi[num_cnt..] when numerical was already seen, else its cursor is
+  // recorded and parsed after the walk. Inline parsing avoids a second
+  // structural pass over the array bytes (skip_composite), which dominated
+  // the per-line cost.
+  Cursor disc_c{nullptr, line_end};
+  bool ok = true;
   bool have_target = false, have_op = false;
   double target = 0.0;
   int op_val = -1;
+  int num_cnt = -1;  // -1 = numericalFeatures not seen yet
+  int disc_cnt = 0;
+  bool disc_seen = false;
 
   while (ok && c.p < c.end) {
     skip_ws(c);
@@ -358,13 +407,39 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
       case KEY_METADATA:
         *validi = 2;  // python fallback (hashing / nesting)
         return;
-      case KEY_NUMERICAL:
-        num_c.p = c.p;
-        if (!skip_value(c)) ok = false;
+      case KEY_NUMERICAL: {
+        if (num_cnt >= 0) {
+          // duplicate array key: inline packing can no longer reproduce the
+          // codec's last-key-wins layout — defer the line to the Python
+          // fallback, which parses it identically to DataInstance.from_json
+          *validi = 2;
+          return;
+        }
+        int cnt = 0;
+        if (!parse_num_array(c, xi, dim, &cnt)) {
+          ok = false;  // malformed / non-numeric array: drop
+          break;
+        }
+        num_cnt = cnt;
         break;
+      }
       case KEY_DISCRETE:
-        disc_c.p = c.p;
-        if (!skip_value(c)) ok = false;
+        if (disc_seen) {
+          *validi = 2;  // duplicate key: Python-fallback (see above)
+          return;
+        }
+        disc_seen = true;
+        if (num_cnt >= 0) {
+          int cnt = 0;
+          if (!parse_num_array(c, xi + num_cnt, dim - num_cnt, &cnt)) {
+            ok = false;
+            break;
+          }
+          disc_cnt = cnt;
+        } else {
+          disc_c.p = c.p;
+          if (!skip_value(c)) ok = false;
+        }
         break;
       case KEY_TARGET: {
         Cursor t{c.p, line_end};
@@ -394,24 +469,18 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   }
   if (!ok) return;
 
-  int pos = 0;
-  if (num_c.p) {
-    int cnt = 0;
-    if (parse_num_array(num_c, xi, dim, &cnt)) {
-      pos = cnt;
-      any = any || cnt > 0;
-    } else {
-      return;  // malformed / non-numeric array: drop
-    }
-  }
+  int pos = num_cnt > 0 ? num_cnt : 0;
   if (disc_c.p) {
+    // discrete appeared before numerical in the line: parse it now so it
+    // still packs after the numerical block
     int cnt = 0;
     if (parse_num_array(disc_c, xi + pos, dim - pos, &cnt)) {
-      any = any || cnt > 0;
+      disc_cnt = cnt;
     } else {
       return;
     }
   }
+  bool any = num_cnt > 0 || disc_cnt > 0;
   if (have_target) *yi = static_cast<float>(target);
   if (have_op) {
     if (op_val < 0) return;  // unknown operation: drop
@@ -426,7 +495,7 @@ extern "C" {
 
 int omldm_parse_lines(const char* buf, long len, int dim, int max_records,
                       float* x, float* y, unsigned char* op,
-                      unsigned char* valid) {
+                      unsigned char* valid, long* bytes_consumed) {
   const char* p = buf;
   const char* bufend = buf + len;
   int i = 0;
@@ -438,12 +507,14 @@ int omldm_parse_lines(const char* buf, long len, int dim, int max_records,
     ++i;
     p = nl ? nl + 1 : bufend;
   }
+  if (bytes_consumed) *bytes_consumed = p - buf;
   return i;
 }
 
 int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,
                          float* x, float* y, unsigned char* op,
-                         unsigned char* valid, int n_threads) {
+                         unsigned char* valid, int n_threads,
+                         long* bytes_consumed) {
   // index line starts (single memchr sweep; never the bottleneck)
   std::vector<long> starts;
   starts.reserve(4096);
@@ -454,6 +525,8 @@ int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,
     const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
     p = nl ? nl + 1 : bufend;
   }
+  const long consumed = p - buf;
+  if (bytes_consumed) *bytes_consumed = consumed;
   int n = static_cast<int>(starts.size());
   if (n == 0) return 0;
   if (n_threads < 1) n_threads = 1;
@@ -462,8 +535,9 @@ int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,
   auto worker = [&](int lo, int hi) {
     for (int i = lo; i < hi; ++i) {
       const char* line = buf + starts[i];
-      // starts[i+1]-1 lands on the '\n'; the final line may lack one
-      long line_len = ((i + 1 < n) ? starts[i + 1] - 1 : len) - starts[i];
+      // starts[i+1]-1 lands on the '\n'; the final indexed line ends at the
+      // consumed offset (== len unless max_records truncated the sweep)
+      long line_len = ((i + 1 < n) ? starts[i + 1] - 1 : consumed) - starts[i];
       if (line_len < 0) line_len = 0;
       const char* line_end = line + line_len;
       if (line_end > bufend) line_end = bufend;

@@ -55,7 +55,16 @@ class PackedBatcher:
         """One block of whole JSON lines -> kept (x[., dim], y, op) rows."""
         if self.parser is None:
             return self._parse_block_python(block)
-        x, y, op, valid = self.parser.parse(block)
+        parsed = self.parser.parse(block)
+        return self._postprocess(parsed, lambda: block)
+
+    def _postprocess(
+        self, parsed, get_block
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Widen to the hash layout + reparse fallback-flagged lines with
+        the Python codec (``get_block`` lazily materializes the bytes —
+        only paid when a line actually needs the fallback)."""
+        x, y, op, valid = parsed
         if self.hash_dims > 0:
             out = np.zeros((x.shape[0], self.dim), np.float32)
             out[:, : x.shape[1]] = x
@@ -63,7 +72,7 @@ class PackedBatcher:
             out = x
         fallback = np.nonzero(valid == 2)[0]
         if fallback.size:
-            lines = block.split(b"\n")
+            lines = get_block().split(b"\n")
             for i in fallback:
                 inst = DataInstance.from_json(
                     lines[i].decode("utf-8", errors="replace")
@@ -105,9 +114,24 @@ class PackedBatcher:
             np.asarray(rows_op, np.uint8),
         )
 
+    def feed_buffer(self, buf: bytearray, start: int, stop: int) -> Iterator[Batch]:
+        """Zero-copy variant of :meth:`feed`: parse ``buf[start:stop]``
+        (whole JSON lines) straight out of the caller's reusable read
+        buffer; bytes are only materialized if a line needs the Python
+        fallback."""
+        if self.parser is None:
+            yield from self.feed(bytes(buf[start:stop]))
+            return
+        parsed = self.parser.parse_range(buf, start, stop)
+        rows = self._postprocess(parsed, lambda: bytes(buf[start:stop]))
+        yield from self._emit(rows)
+
     def feed(self, block: bytes) -> Iterator[Batch]:
         """Consume a byte block of whole JSON lines; yields full batches."""
-        x, y, op = self._parse_block(block)
+        yield from self._emit(self._parse_block(block))
+
+    def _emit(self, rows: Tuple[np.ndarray, np.ndarray, np.ndarray]) -> Iterator[Batch]:
+        x, y, op = rows
         if x.shape[0] == 0:
             return
         if self._carry_x.shape[0]:
@@ -138,23 +162,33 @@ def iter_file_batches(
     path: str, dim: int, batch_size: int, hash_dims: int = 0,
     chunk_bytes: int = 1 << 22, n_threads: int = 0,
 ) -> Iterator[Batch]:
-    """Stream a JSON-lines file as packed (x, y, op) batches."""
+    """Stream a JSON-lines file as packed (x, y, op) batches.
+
+    Reads into one reusable buffer (``readinto``) and parses in place —
+    the only per-chunk copy is the carried partial line moved to the
+    buffer head."""
     b = PackedBatcher(dim, batch_size, hash_dims, n_threads)
+    buf = bytearray(chunk_bytes)
+    carry = 0  # bytes of partial line sitting at buf[:carry]
     with open(path, "rb") as f:
-        leftover = b""
         while True:
-            chunk = f.read(chunk_bytes)
-            if not chunk:
+            if carry >= len(buf):  # one line longer than the whole buffer
+                buf.extend(bytes(len(buf)))
+            n = f.readinto(memoryview(buf)[carry:])
+            if not n:
                 break
-            chunk = leftover + chunk
-            cut = chunk.rfind(b"\n")
+            end = carry + n
+            cut = buf.rfind(b"\n", 0, end)
             if cut < 0:
-                leftover = chunk
+                carry = end
                 continue
-            leftover = chunk[cut + 1 :]
-            yield from b.feed(chunk[: cut + 1])
-        if leftover:
-            yield from b.feed(leftover + b"\n")
+            yield from b.feed_buffer(buf, 0, cut + 1)
+            carry = end - (cut + 1)
+            if carry:
+                buf[:carry] = buf[cut + 1 : end]
+        if carry:
+            buf[carry : carry + 1] = b"\n"
+            yield from b.feed_buffer(buf, 0, carry + 1)
     tail = b.flush()
     if tail:
         yield tail

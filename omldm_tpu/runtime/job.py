@@ -15,9 +15,10 @@ Kafka sources, with the same termination protocol driven by a silence timer.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
 
 from omldm_tpu.api.data import FORECASTING, TRAINING, DataInstance, Prediction
 from omldm_tpu.api.requests import Request, RequestType
@@ -38,6 +39,10 @@ REQUEST_STREAM = "requests"
 # pseudo-stream carrying pre-vectorized (x, y, op) blocks from the C++
 # bulk-ingest path (runtime.fast_ingest); replaces per-record JSON events
 PACKED_STREAM = "__packed__"
+
+# rows held for pipelines that have not been created yet, before the FIRST
+# deploy (the reference's recordBuffer cap, SpokeLogic.scala:31-35)
+PRE_CREATE_BACKLOG_CAP = 100_000
 
 
 class StreamJob:
@@ -74,6 +79,15 @@ class StreamJob:
         self._rr = 0  # round-robin data partitioner (the reference rebalances)
         self._pending_creates: List[Request] = []  # awaiting dim inference
         self._dims: dict = {}  # network_id -> feature dim
+        # data that arrives before ANY pipeline is deployed is held here and
+        # replayed through the normal routing on the first deploy — the
+        # job-level equivalent of the reference's pre-creation recordBuffer
+        # (FlinkSpoke.scala:69-80, SpokeLogic.scala:31-35, cap 100k). Without
+        # it, a stream whose records precede the Create request would never
+        # reach an SPMD-engine pipeline (bridges don't exist yet when the
+        # rows flow) and would train only on the host plane's spoke buffers.
+        self._backlog: Deque[tuple] = collections.deque()
+        self._backlog_rows = 0
         # pipelines deployed on the SPMD collective engine instead of the
         # host plane (trainingConfiguration {"engine": "spmd"})
         self.spmd_bridges: Dict[int, Any] = {}
@@ -201,14 +215,52 @@ class StreamJob:
 
     def _infer_dim_from_buffers(self, request: Request) -> Optional[int]:
         hash_dims = int(request.training_configuration.extra.get("hashDims", 0))
+        for kind, *payload in self._backlog:
+            if kind == "inst":
+                return Vectorizer.infer_dim(payload[0], hash_dims)
+            # packed rows already include any hashed-categorical region
+            return int(payload[0].shape[1])
         for spoke in self.spokes:
             for inst in spoke.record_buffer:
                 return Vectorizer.infer_dim(inst, hash_dims)
             packed_dim = spoke.buffered_packed_dim()
             if packed_dim is not None:
-                # packed rows already include any hashed-categorical region
                 return packed_dim
         return None
+
+    def _push_backlog(self, entry: tuple, rows: int) -> None:
+        """Append, then trim the OLDEST rows down to the cap — partial
+        trims on packed entries (same keep-newest semantics as the spoke's
+        packed buffer), so an oversized batch keeps its newest cap rows
+        instead of being dropped whole."""
+        self._backlog.append(entry)
+        self._backlog_rows += rows
+        while self._backlog and self._backlog_rows > PRE_CREATE_BACKLOG_CAP:
+            excess = self._backlog_rows - PRE_CREATE_BACKLOG_CAP
+            kind, *payload = self._backlog[0]
+            if kind == "inst":
+                self._backlog.popleft()
+                self._backlog_rows -= 1
+                continue
+            x, y, op = payload
+            n = int(x.shape[0])
+            if n <= excess:
+                self._backlog.popleft()
+                self._backlog_rows -= n
+            else:
+                self._backlog[0] = ("packed", x[excess:], y[excess:], op[excess:])
+                self._backlog_rows -= excess
+
+    def _replay_backlog(self) -> None:
+        if not self._backlog:
+            return
+        backlog, self._backlog = self._backlog, collections.deque()
+        self._backlog_rows = 0
+        for kind, *payload in backlog:
+            if kind == "inst":
+                self._handle_data(payload[0])
+            else:
+                self.process_packed_batch(*payload)
 
     def _request_dim(self, request: Request) -> Optional[int]:
         """Feature dim from the request's dataStructure (nFeatures), else None
@@ -250,11 +302,13 @@ class StreamJob:
                 request, dim, self.config,
                 self._emit_prediction, self._route_response_fragment,
             )
+            self._replay_backlog()
             return
         for spoke in self.spokes:
             spoke.handle_request(request, dim)
         for h in range(request.training_configuration.hub_parallelism):
             self.hub_manager.create_hub(request, h, dim)
+        self._replay_backlog()
 
     def _handle_data(self, inst: DataInstance) -> None:
         self.stats.mark_activity()
@@ -266,6 +320,10 @@ class StreamJob:
                 )
                 dim = Vectorizer.infer_dim(inst, hash_dims)
                 self._deploy(request, dim)
+        if not self._dims:
+            # nothing deployed yet: hold for replay on the first deploy
+            self._push_backlog(("inst", inst), 1)
+            return
         spoke = self.spokes[self._rr % len(self.spokes)]
         self._rr += 1
         spoke.handle_data(inst)
@@ -290,6 +348,9 @@ class StreamJob:
             pending, self._pending_creates = self._pending_creates, []
             for request in pending:
                 self._deploy(request, int(x.shape[1]))
+        if not self._dims:
+            self._push_backlog(("packed", x, y, op), n)
+            return
         p = len(self.spokes)
         for w in range(p):
             start = (w - self._rr) % p

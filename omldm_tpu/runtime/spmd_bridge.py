@@ -33,6 +33,11 @@ from omldm_tpu.runtime.spoke import PREDICT_BATCH
 from omldm_tpu.runtime.vectorizer import Vectorizer
 
 
+# flush remainders pad to this sub-batch instead of a full dp*B group
+# (a 1-row tail no longer ships half a megabyte of zeros)
+TAIL_BATCH = 256
+
+
 def spmd_engine_requested(request: Request) -> bool:
     return (
         str(request.training_configuration.extra.get("engine", "")).lower()
@@ -91,9 +96,15 @@ class SPMDBridge:
         # cost dominates through the TPU tunnel and is real on any host)
         self.chain = max(int(tc.extra.get("stageChain", 8)), 1)
         b = config.batch_size
+        # optional narrow feed dtype: float16 staging halves host->device
+        # bytes (compute stays f32 — the jitted step casts on device)
+        feed = str(tc.extra.get("feedDtype", "float32"))
+        if feed not in ("float32", "float16"):
+            raise ValueError(f"feedDtype must be float32|float16, got {feed!r}")
+        self.feed_dtype = np.dtype(feed)
         self._stage_cap = self.chain * dp * b
-        self._stage_x = np.zeros((self._stage_cap, dim), np.float32)
-        self._stage_y = np.zeros((self._stage_cap,), np.float32)
+        self._stage_x = np.zeros((self._stage_cap, dim), self.feed_dtype)
+        self._stage_y = np.zeros((self._stage_cap,), self.feed_dtype)
         self._stage_n = 0
 
     # --- data path ---
@@ -198,45 +209,53 @@ class SPMDBridge:
                 self._train_staged(full=True)
 
     def _train_staged(self, full: bool = False) -> None:
-        """Launch the staged rows: a full stage is one chained step_many of
-        ``chain`` [dp, B, D] steps; a partial stage (flush) runs whole
-        [dp, B] groups as single steps and pads the remainder with a zero
-        mask."""
+        """Launch the staged rows: a full stage is one chained mask-free
+        step_many_dense launch of ``chain`` [dp, B, D] steps (the stage
+        buffer is exactly chain*dp*B rows, so every row is valid and no
+        mask ships); a partial stage (flush) runs whole [dp, B] groups as
+        single steps and the remainder through a small [dp, TAIL_B] padded
+        step instead of padding a whole dp*B group for a handful of rows."""
         n = self._stage_n
         if n == 0:
             return
         b = self.config.batch_size
         group = self.dp * b
-        if full and self.chain > 1:
+        if full:
             xs = self._stage_x.reshape(self.chain, self.dp, b, self.dim)
             ys = self._stage_y.reshape(self.chain, self.dp, b)
-            masks = np.ones((self.chain, self.dp, b), np.float32)
-            self.trainer.step_many(xs, ys, masks)
+            self.trainer.step_many_dense(xs, ys)
             self._stage_n = 0
             return
         done = 0
         while n - done >= group:
             self.trainer.step(
-                self._stage_x[done : done + group].reshape(self.dp, b, self.dim),
-                self._stage_y[done : done + group].reshape(self.dp, b),
+                self._stage_x[done : done + group]
+                .reshape(self.dp, b, self.dim)
+                .astype(np.float32, copy=False),
+                self._stage_y[done : done + group]
+                .reshape(self.dp, b)
+                .astype(np.float32, copy=False),
                 np.ones((self.dp, b), np.float32),
                 valid_count=group,
             )
             done += group
-        rem = n - done
-        if rem > 0:
-            x = np.zeros((group, self.dim), np.float32)
-            y = np.zeros((group,), np.float32)
-            mask = np.zeros((group,), np.float32)
-            x[:rem] = self._stage_x[done:n]
-            y[:rem] = self._stage_y[done:n]
+        tail_b = min(b, TAIL_BATCH)
+        tail_group = self.dp * tail_b
+        while n - done > 0:
+            rem = min(n - done, tail_group)
+            x = np.zeros((tail_group, self.dim), np.float32)
+            y = np.zeros((tail_group,), np.float32)
+            mask = np.zeros((tail_group,), np.float32)
+            x[:rem] = self._stage_x[done : done + rem]
+            y[:rem] = self._stage_y[done : done + rem]
             mask[:rem] = 1.0
             self.trainer.step(
-                x.reshape(self.dp, b, self.dim),
-                y.reshape(self.dp, b),
-                mask.reshape(self.dp, b),
+                x.reshape(self.dp, tail_b, self.dim),
+                y.reshape(self.dp, tail_b),
+                mask.reshape(self.dp, tail_b),
                 valid_count=rem,
             )
+            done += rem
         self._stage_n = 0
 
     def flush(self) -> None:

@@ -263,3 +263,99 @@ class TestSilenceTimer:
         job.stats.mark_activity(100.0)
         assert not job.stats.silence_exceeded(100.5)
         assert job.stats.silence_exceeded(101.1)
+
+
+class TestPreCreateBacklog:
+    """Data that precedes the Create request must still train the pipeline
+    once it deploys — on EITHER plane (the reference buffers pre-creation
+    records and drains them after createWrapper, FlinkSpoke.scala:69-80;
+    the CLI's interleaved file replay routinely delivers the first packed
+    block before the request stream's Create)."""
+
+    def _packed_events(self, n=3000, dim=8, seed=0, batch=1024):
+        from omldm_tpu.runtime.job import PACKED_STREAM
+
+        rng = np.random.RandomState(seed)
+        w = rng.randn(dim)
+        x = rng.randn(n, dim).astype(np.float32)
+        y = (x @ w > 0).astype(np.float32)
+        op = np.zeros(n, np.uint8)
+        return [
+            (PACKED_STREAM, (x[i : i + batch], y[i : i + batch], op[i : i + batch]))
+            for i in range(0, n, batch)
+        ]
+
+    def test_packed_rows_before_create_reach_spmd_bridge(self):
+        create = {
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "Softmax",
+                "hyperParameters": {"learningRate": 0.1, "nClasses": 2},
+                "dataStructure": {"nFeatures": 8},
+            },
+            "preProcessors": [],
+            "trainingConfiguration": {
+                "protocol": "Synchronous",
+                "engine": "spmd",
+                "extra": {"stageChain": 2},
+            },
+        }
+        cfg = JobConfig(parallelism=1, batch_size=256, test_set_size=64)
+        job = StreamJob(cfg)
+        # ALL data arrives before the Create (the failure mode: one packed
+        # block holding the whole small file)
+        for stream, payload in self._packed_events():
+            job.process_event(stream, payload)
+        job.process_event(REQUEST_STREAM, json.dumps(create))
+        [bridge] = job.spmd_bridges.values()
+        bridge.flush()
+        assert bridge.trainer.fitted > 2000
+        stats = bridge.network_statistics()
+        assert stats.score > 0.8
+
+    def test_packed_rows_before_create_reach_host_plane(self):
+        create = dict(CREATE)
+        cfg = JobConfig(parallelism=2, batch_size=256, test_set_size=64)
+        job = StreamJob(cfg)
+        for stream, payload in self._packed_events():
+            job.process_event(stream, payload)
+        job.process_event(REQUEST_STREAM, json.dumps(create))
+        # drive termination for the full statistics path
+        report = job.run([])
+        [stats] = report.statistics
+        assert stats.fitted > 2000
+
+    def test_backlog_capped(self):
+        from omldm_tpu.runtime.job import PRE_CREATE_BACKLOG_CAP
+
+        cfg = JobConfig(parallelism=1, batch_size=256)
+        job = StreamJob(cfg)
+        dim = 4
+        x = np.zeros((60_000, dim), np.float32)
+        y = np.zeros((60_000,), np.float32)
+        op = np.zeros((60_000,), np.uint8)
+        from omldm_tpu.runtime.job import PACKED_STREAM
+
+        for _ in range(3):  # 180k rows > cap
+            job.process_event(PACKED_STREAM, (x, y, op))
+        assert job._backlog_rows == PRE_CREATE_BACKLOG_CAP
+
+    def test_backlog_single_oversized_batch_keeps_newest(self):
+        from omldm_tpu.runtime.job import (
+            PACKED_STREAM,
+            PRE_CREATE_BACKLOG_CAP,
+        )
+
+        cfg = JobConfig(parallelism=1, batch_size=256)
+        job = StreamJob(cfg)
+        n = PRE_CREATE_BACKLOG_CAP + 5000
+        x = np.arange(n, dtype=np.float32)[:, None]
+        y = np.zeros((n,), np.float32)
+        op = np.zeros((n,), np.uint8)
+        job.process_event(PACKED_STREAM, (x, y, op))
+        assert job._backlog_rows == PRE_CREATE_BACKLOG_CAP
+        kind, bx, _, _ = job._backlog[0]
+        # newest rows kept (partial trim, not a whole-entry drop)
+        assert kind == "packed" and float(bx[-1, 0]) == float(n - 1)
+        assert float(bx[0, 0]) == 5000.0
