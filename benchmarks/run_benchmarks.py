@@ -218,6 +218,50 @@ def bench_longctx_transformer(steps):
     return "longctx_transformer_lm", thr
 
 
+def bench_flash_attention(steps):
+    """Pallas flash kernel vs the lax blockwise scan on the same chip:
+    causal attention at L=8192 (the long-context hot op). Reported value is
+    the Pallas kernel's causal TFLOP/s; the lax figure and speedup ride
+    along as fields."""
+    import jax
+    import jax.numpy as jnp
+
+    from omldm_tpu.ops.attention import (
+        blockwise_attention, flash_attention_pallas,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rng = np.random.RandomState(0)
+    b, l, h, dh = 4, 8192, 8, 64
+    q = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.1)
+    k = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.1)
+    v = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.1)
+    flops = 4 * b * h * l * l * dh / 2  # causal half
+
+    def time_fn(fn, rounds=5):
+        jax.block_until_ready(fn())
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            o = fn()
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / rounds
+
+    t_lax = time_fn(lambda: blockwise_attention(q, k, v, causal=True))
+    if on_tpu:
+        t_pl = time_fn(
+            lambda: flash_attention_pallas(q, k, v, causal=True)
+        )
+    else:  # interpret mode is not a performance path; report lax only
+        t_pl = t_lax
+    return "flash_attention_L8192", flops / t_pl / 1e12, {
+        "pallas_ms": round(t_pl * 1000, 2),
+        "lax_blockwise_ms": round(t_lax * 1000, 2),
+        "lax_blockwise_tflops": round(flops / t_lax / 1e12, 2),
+        "speedup_vs_lax": round(t_lax / t_pl, 1),
+        "pallas_compiled": on_tpu,
+    }
+
+
 def _gen_stream_file(path, n_records, dim, seed=0):
     import numpy as np
 
@@ -462,15 +506,23 @@ def main():
         bench_susy_rff_svm,
         bench_avazu_softmax_dp8,
         bench_longctx_transformer,
+        bench_flash_attention,
     ):
-        name, thr = fn(args.steps)
-        unit = "tokens/sec/chip" if "transformer" in name else "examples/sec/chip"
+        out = fn(args.steps)
+        name, thr = out[0], out[1]
+        extra = out[2] if len(out) > 2 else {}
+        unit = (
+            "TFLOP/s (causal)" if "flash" in name
+            else "tokens/sec/chip" if "transformer" in name
+            else "examples/sec/chip"
+        )
         print(
             json.dumps(
                 {
                     "config": name,
                     "metric": unit,
                     "value": round(thr, 1),
+                    **extra,
                 }
             )
         )

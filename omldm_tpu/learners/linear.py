@@ -85,12 +85,17 @@ class PAClassifier(Learner):
         return {"w": new_w}, masked_mean(hinge, mask)
 
     def update_per_record(self, params, x, y, mask):
-        """Exact sequential pass; with ``usePallas`` set, the fused VMEM
-        kernel (omldm_tpu.ops.pa_scan) replaces the generic lax.scan."""
-        if self.hp.get("usePallas"):
-            from omldm_tpu.ops.pa_scan import pa_scan_update
+        """Exact sequential pass. The fused VMEM kernel
+        (omldm_tpu.ops.pa_scan) replaces the generic lax.scan by default on
+        TPU; ``usePallas`` forces it on (interpret mode off-TPU, for tests)
+        or off."""
+        import jax as _jax
 
-            import jax as _jax
+        use_pallas = self.hp.get("usePallas")
+        if use_pallas is None:
+            use_pallas = _jax.devices()[0].platform == "tpu"
+        if use_pallas:
+            from omldm_tpu.ops.pa_scan import pa_scan_update
 
             interpret = _jax.devices()[0].platform != "tpu"
             new_w, loss = pa_scan_update(

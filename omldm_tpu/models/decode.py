@@ -121,6 +121,10 @@ def generate(
     """Prefill + n_tokens greedy (temperature 0) or sampled decode steps,
     fully on device. Returns the generated tokens [B, n_tokens]."""
     b, t_prompt = prompt.shape
+    if n_tokens <= 0:
+        # nothing to decode: an empty [B, 0] result, not an IndexError from
+        # splitting zero sampling keys
+        return jnp.zeros((b, 0), jnp.int32)
     max_len = max_len or cfg.max_len
     if max_len > cfg.max_len:
         # the positional table has cfg.max_len rows; a longer cache would

@@ -183,19 +183,30 @@ def _mlp_block(layer, x, axes: AxisSpec):
     return _psum_if(out, axes.tp)
 
 
-def _moe_block_dense(layer, x):
-    """Single-device switch MoE: dense top-1 dispatch (all experts computed,
-    gate selects). Exact semantics the EP path must match."""
+def _moe_block_dense(layer, x, capacity_factor: float):
+    """Single-device switch MoE: dense compute (all experts), top-1 gate
+    select — with the SAME per-expert capacity rule as the EP path, so a
+    model trained dense and served expert-parallel (or vice versa) computes
+    the same function: over-capacity tokens drop to the residual in both."""
     b, lc, d = x.shape
     t = x.reshape(-1, d)                              # [T, D]
+    T = t.shape[0]
+    n_experts = layer["w1"].shape[0]
+    cap = max(int(capacity_factor * T / n_experts), 1)
     logits = t @ layer["router"]                      # [T, E]
     gate = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(gate, axis=-1)                # [T]
     gval = jnp.max(gate, axis=-1)                     # [T]
+    # same capacity/priority rule as _moe_block_ep: position order within
+    # each expert, tokens past the expert's cap drop to the residual
+    onehot_i = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+    pos_in_e = jnp.cumsum(onehot_i, axis=0) * onehot_i
+    keep = (jnp.sum(pos_in_e, axis=-1) - 1) < cap
     h = jax.nn.relu(jnp.einsum("td,edf->tef", t, layer["w1"]))
     y = jnp.einsum("tef,efd->ted", h, layer["w2"])    # [T, E, D]
-    onehot = jax.nn.one_hot(expert, layer["w1"].shape[0], dtype=y.dtype)
+    onehot = onehot_i.astype(y.dtype)
     out = jnp.einsum("ted,te->td", y, onehot) * gval[:, None].astype(y.dtype)
+    out = jnp.where(keep[:, None], out, 0.0)
     return out.reshape(b, lc, d)
 
 
@@ -272,7 +283,7 @@ def transformer_forward(
             if axes.ep:
                 y = _moe_block_ep(layer, z, axes.ep, cfg.capacity_factor)
             else:
-                y = _moe_block_dense(layer, z)
+                y = _moe_block_dense(layer, z, cfg.capacity_factor)
         else:
             y = _mlp_block(layer, z, axes)
         return x + y

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from omldm_tpu.ops.attention import NEG_INF
+from omldm_tpu.ops.attention import NEG_INF, online_softmax_sweep
 
 
 def ring_attention(
@@ -32,6 +32,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Per-shard ring attention. q,k,v: the LOCAL chunk [B, Lc, H, Dh];
     shard i owns absolute positions [i*Lc, (i+1)*Lc). Must run inside
@@ -39,7 +40,6 @@ def ring_attention(
     b, lc, h, dh = q.shape
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    scale = 1.0 / jnp.sqrt(float(dh))
     q32 = q.astype(jnp.float32)
     q_pos = idx * lc + jnp.arange(lc)  # absolute query positions [Lc]
 
@@ -47,21 +47,13 @@ def ring_attention(
 
     def accumulate(acc, kc, vc, src):
         """Online-softmax update of (o, m, l) against the chunk whose origin
-        shard is ``src`` (absolute key positions src*Lc + [0, Lc))."""
-        o, m, l = acc
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kc.astype(jnp.float32)) * scale
-        if causal:
-            k_pos = src * lc + jnp.arange(lc)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # guard: rows whose every key so far is masked keep weight 0
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
-        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+        shard is ``src`` (absolute key positions src*Lc + [0, Lc)). The
+        chunk is swept in block_k-sized key blocks — peak score memory is
+        [B, H, Lc, block_k], not the full O(Lc^2) chunk pair."""
+        return online_softmax_sweep(
+            q32, kc, vc, acc, q_pos, src * lc, causal=causal,
+            block_k=block_k,
         )
-        return o_new, m_new, l_new
 
     # derive the zero accumulators from q so they inherit its device-varying
     # type (shard_map's vma checking requires the scan carry types to match)

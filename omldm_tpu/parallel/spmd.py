@@ -494,30 +494,47 @@ class SPMDTrainer:
         host_state = load_tree(directory)
         self.state = place_tree(host_state, self._state_specs, self.mesh)
 
+    def _serve_fns(self):
+        """Jitted worker-0 serving programs, compiled once and cached: the
+        whole (slice shard 0 -> preprocess -> predict/eval) chain runs on
+        device — the previous implementation device_get the ENTIRE model
+        pytree per call, which put a full fleet-state transfer on the
+        per-forecast serving hot path."""
+        if getattr(self, "_serve_cache", None) is None:
+
+            def w0(tree):
+                return jax.tree_util.tree_map(lambda l: l[0, 0], tree)
+
+            def transform(state, z):
+                for prep, s in zip(self.preps, state["preps"]):
+                    z = prep.transform(w0(s), z)
+                return z
+
+            def predict_fn(state, x):
+                z = transform(state, x)
+                return self.learner.predict(w0(state["params"]), z)
+
+            def eval_fn(state, x, y, mask):
+                z = transform(state, x)
+                params = w0(state["params"])
+                return (
+                    self.learner.loss(params, z, y, mask),
+                    self.learner.score(params, z, y, mask),
+                )
+
+            self._serve_cache = (jax.jit(predict_fn), jax.jit(eval_fn))
+        return self._serve_cache
+
     def predict(self, x) -> np.ndarray:
         """Serve with the worker-0 model (post-sync replicas agree):
         transform through its preprocessor state, then learner.predict."""
-        params = jax.tree_util.tree_map(
-            lambda l: jax.device_get(l)[0, 0], self.state["params"]
-        )
-        z = jnp.asarray(x)
-        for prep, s in zip(self.preps, self.state["preps"]):
-            s0 = jax.tree_util.tree_map(lambda l: jax.device_get(l)[0, 0], s)
-            z = prep.transform(s0, z)
-        return np.asarray(self.learner.predict(params, z))
+        predict_fn, _ = self._serve_fns()
+        return np.asarray(predict_fn(self.state, jnp.asarray(x)))
 
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """Loss/score of the worker-0 model on a host-side holdout set."""
-        params = jax.tree_util.tree_map(
-            lambda l: jax.device_get(l)[0, 0], self.state["params"]
+        _, eval_fn = self._serve_fns()
+        loss, score = eval_fn(
+            self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
         )
-        prep_states = [
-            jax.tree_util.tree_map(lambda l: jax.device_get(l)[0, 0], s)
-            for s in self.state["preps"]
-        ]
-        z = jnp.asarray(x)
-        for prep, s in zip(self.preps, prep_states):
-            z = prep.transform(s, z)
-        loss = self.learner.loss(params, z, jnp.asarray(y), jnp.asarray(mask))
-        score = self.learner.score(params, z, jnp.asarray(y), jnp.asarray(mask))
         return float(loss), float(score)

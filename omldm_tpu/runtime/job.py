@@ -215,7 +215,8 @@ class StreamJob:
 
     def _infer_dim_from_buffers(self, request: Request) -> Optional[int]:
         hash_dims = int(request.training_configuration.extra.get("hashDims", 0))
-        for kind, *payload in self._backlog:
+        if self._backlog:  # peek the oldest pre-create entry
+            kind, *payload = self._backlog[0]
             if kind == "inst":
                 return Vectorizer.infer_dim(payload[0], hash_dims)
             # packed rows already include any hashed-categorical region
@@ -248,7 +249,11 @@ class StreamJob:
                 self._backlog.popleft()
                 self._backlog_rows -= n
             else:
-                self._backlog[0] = ("packed", x[excess:], y[excess:], op[excess:])
+                # copy: a slice view would pin the whole untrimmed batch
+                self._backlog[0] = (
+                    "packed", x[excess:].copy(), y[excess:].copy(),
+                    op[excess:].copy(),
+                )
                 self._backlog_rows -= excess
 
     def _replay_backlog(self) -> None:

@@ -97,7 +97,10 @@ class SPMDBridge:
         self.chain = max(int(tc.extra.get("stageChain", 8)), 1)
         b = config.batch_size
         # optional narrow feed dtype: float16 staging halves host->device
-        # bytes (compute stays f32 — the jitted step casts on device)
+        # bytes. This is LOSSY quantization of the inputs, not a transport
+        # trick: features/targets round to fp16 (~3 decimal digits,
+        # |x| <= 65504) before the on-device f32 cast. Opt in only for
+        # streams whose value range tolerates it.
         feed = str(tc.extra.get("feedDtype", "float32"))
         if feed not in ("float32", "float16"):
             raise ValueError(f"feedDtype must be float32|float16, got {feed!r}")
@@ -267,7 +270,17 @@ class SPMDBridge:
         if self.test_set.is_empty:
             return 0.0, 0.0
         xs, ys = self.test_set.arrays()
-        return self.trainer.evaluate(xs, ys, np.ones(len(ys), np.float32))
+        # pad to the holdout capacity so the jitted eval program compiles
+        # once, not once per fill level while the holdout warms up
+        cap = self.test_set.max_size
+        n = len(ys)
+        if n < cap:
+            pad = cap - n
+            xs = np.concatenate([xs, np.zeros((pad, xs.shape[1]), xs.dtype)])
+            ys = np.concatenate([ys, np.zeros((pad,), ys.dtype)])
+        mask = np.zeros((cap,), np.float32)
+        mask[:n] = 1.0
+        return self.trainer.evaluate(xs, ys, mask)
 
     def emit_query_response(self, response_id: int) -> None:
         """Bucketed QueryResponse (FlinkNetwork.scala:48-149,151-240); the

@@ -93,3 +93,13 @@ def test_forward_with_cache_rejects_bad_configs_and_overflow():
     _, cache = forward_with_cache(CFG, params, jnp.ones((1, 6), jnp.int32), cache)
     with pytest.raises(ValueError, match="cache overflow"):
         forward_with_cache(CFG, params, jnp.ones((1, 4), jnp.int32), cache)
+
+
+def test_generate_zero_tokens_returns_empty():
+    """generate(n_tokens=0) must return [B, 0], not IndexError on an empty
+    key split (regression)."""
+    params = init_transformer(CFG, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.zeros((3, 4), np.int32))
+    out = generate(CFG, params, prompt, n_tokens=0)
+    assert out.shape == (3, 0)
+    assert out.dtype == jnp.int32

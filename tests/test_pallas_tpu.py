@@ -1,0 +1,134 @@
+"""Pallas kernels on REAL TPU hardware (interpret=False).
+
+The rest of the suite pins the CPU backend (tests/conftest.py), so these
+tests drive the chip from a subprocess with the default backend. They skip
+when no TPU is reachable — on the CI host with the axon tunnel they run the
+compiled kernels:
+
+- flash_attention_pallas vs the full-softmax reference (causal + offsets),
+  including a context length whose K/V could never fit a per-program VMEM
+  staging (the regression the grid-tiled kernel fixed);
+- pa_scan_update vs the exact numpy sequential PA recurrence;
+- the attention() entry point dispatching to Pallas by default on TPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if jax.devices()[0].platform != "tpu":
+    print(json.dumps({"skip": "no tpu"}))
+    raise SystemExit(0)
+
+from omldm_tpu.ops.attention import (
+    attention, flash_attention_pallas, mha_reference,
+)
+from omldm_tpu.ops.pa_scan import pa_scan_update
+
+out = {}
+rng = np.random.RandomState(0)
+b, l, h, dh = 2, 1024, 4, 64
+q = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.3)
+k = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.3)
+v = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.3)
+for causal in (False, True):
+    err = float(jnp.max(jnp.abs(
+        flash_attention_pallas(q, k, v, causal=causal)
+        - mha_reference(q, k, v, causal=causal)
+    )))
+    out[f"flash_err_causal_{causal}"] = err
+# chunked-query offsets (the ring/Ulysses entry pattern)
+err = float(jnp.max(jnp.abs(
+    flash_attention_pallas(q[:, 256:512], k, v, causal=True, q_offset=256)
+    - mha_reference(q[:, 256:512], k, v, causal=True, q_offset=256)
+)))
+out["flash_err_offset"] = err
+# long context: per-(batch,head) K/V staging would need ~16 MB of VMEM for
+# K+V alone at this length; the tiled kernel runs in O(block) VMEM
+ll = 32768
+ql = jnp.asarray(rng.randn(1, ll, 1, dh).astype(np.float32) * 0.1)
+kl = jnp.asarray(rng.randn(1, ll, 1, dh).astype(np.float32) * 0.1)
+vl = jnp.asarray(rng.randn(1, ll, 1, dh).astype(np.float32) * 0.1)
+ol = flash_attention_pallas(ql, kl, vl, causal=True)
+out["longctx_finite"] = bool(jnp.isfinite(ol).all())
+
+# attention() entry must dispatch to the Pallas kernel on TPU and match
+err = float(jnp.max(jnp.abs(
+    attention(q, k, v, causal=True) - mha_reference(q, k, v, causal=True)
+)))
+out["entry_err"] = err
+
+# pa_scan on the chip vs the exact numpy recurrence
+D, B = 29, 512
+w0 = np.zeros(D, np.float32)
+x = rng.randn(B, D).astype(np.float32)
+y = (x @ rng.randn(D) > 0).astype(np.float32)
+m = np.ones(B, np.float32)
+new_w, loss = pa_scan_update(
+    jnp.asarray(w0), jnp.asarray(x), jnp.asarray(y), jnp.asarray(m),
+    variant="PA-I", C=0.5, interpret=False,
+)
+w = w0.copy()
+hinge_sum = 0.0
+for i in range(B):
+    ys = 1.0 if y[i] > 0 else -1.0
+    margin = float(w @ x[i])
+    hinge = max(0.0, 1.0 - ys * margin)
+    tau = min(0.5, hinge / max(float(x[i] @ x[i]), 1e-12))
+    w = w + tau * ys * x[i]
+    hinge_sum += hinge
+out["pa_w_err"] = float(np.max(np.abs(np.asarray(new_w) - w)))
+out["pa_loss_err"] = abs(float(loss) - hinge_sum / B)
+print(json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def tpu_results():
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True, text=True, cwd=_ROOT, env=env, timeout=900,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        data = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        pytest.skip(
+            "TPU subprocess produced no result (chip busy or unreachable): "
+            f"rc={proc.returncode} stderr={proc.stderr[-500:]}"
+        )
+    if "skip" in data:
+        pytest.skip(data["skip"])
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    return data
+
+
+class TestPallasOnTPU:
+    def test_flash_attention_matches_reference(self, tpu_results):
+        # the QK^T dot rides the MXU at default (bf16-accumulated) precision
+        assert tpu_results["flash_err_causal_False"] < 5e-3
+        assert tpu_results["flash_err_causal_True"] < 5e-3
+        assert tpu_results["flash_err_offset"] < 5e-3
+
+    def test_flash_attention_long_context(self, tpu_results):
+        assert tpu_results["longctx_finite"] is True
+
+    def test_attention_entry_dispatches_pallas(self, tpu_results):
+        assert tpu_results["entry_err"] < 5e-3
+
+    def test_pa_scan_exact_recurrence(self, tpu_results):
+        assert tpu_results["pa_w_err"] < 1e-4
+        assert tpu_results["pa_loss_err"] < 1e-4

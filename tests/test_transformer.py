@@ -215,3 +215,53 @@ def test_lm_loss_perfect_prediction_near_zero():
     for _ in range(200):
         loss = trainer.step(tokens, targets, mask)
     assert float(np.asarray(loss)) < 0.5
+
+
+def test_moe_dense_applies_capacity_like_ep():
+    """The dense path must enforce the SAME per-expert capacity rule as the
+    EP path: under routing imbalance, over-capacity tokens drop to the
+    residual in BOTH deployments (a model trained dense and served
+    expert-parallel computes the same function)."""
+    import jax
+    import jax.numpy as jnp
+
+    from omldm_tpu.models.transformer import (
+        _moe_block_dense,
+        _moe_block_ep,
+    )
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    d, f, e = 8, 16, 4
+    b, lc = 2, 8  # T = 16 tokens
+    layer = {
+        # router rigged so EVERY token picks expert 0 -> maximal imbalance
+        "router": jnp.asarray(
+            np.concatenate(
+                [np.full((d, 1), 5.0), np.zeros((d, e - 1))], axis=1
+            ).astype(np.float32)
+        ),
+        "w1": jnp.asarray(rng.randn(e, d, f).astype(np.float32) * 0.1),
+        "w2": jnp.asarray(rng.randn(e, f, d).astype(np.float32) * 0.1),
+    }
+    x = jnp.asarray(np.abs(rng.randn(b, lc, d)).astype(np.float32) * 0.5)
+
+    cf = 1.0  # cap = T/E = 4 slots on expert 0; 12 of 16 tokens must drop
+    out_dense = _moe_block_dense(layer, x, cf)
+    t = out_dense.reshape(-1, d)
+    nonzero = np.count_nonzero(np.abs(np.asarray(t)).sum(axis=1) > 1e-9)
+    assert nonzero == 4, f"expected cap=4 kept tokens, got {nonzero}"
+
+    # ep=1 EP path == dense path exactly, including the dropped tokens
+    mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+    ep_fn = jax.shard_map(
+        lambda xx: _moe_block_ep(layer, xx, "ep", cf),
+        mesh=mesh,
+        in_specs=P(),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out_ep = ep_fn(x)
+    np.testing.assert_allclose(
+        np.asarray(out_dense), np.asarray(out_ep), atol=1e-5
+    )
