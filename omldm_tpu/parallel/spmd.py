@@ -28,13 +28,22 @@ Protocol mapping (SURVEY.md section 7 step 5):
                       machinery, so the safe-zone semantics are kept and the
                       counters retired — see the host-plane FGM for the
                       faithful two-phase variant.)
-- ``Asynchronous``  — staggered sync: worker w folds its delta into the
-                      shared global every ``syncEvery`` steps at offset
-                      w mod syncEvery, emulating uncoordinated PS pushes in
-                      lockstep SPMD.
-- ``SSP``           — same staggered schedule; the staleness bound is
-                      trivially satisfied in lockstep (the host plane
-                      implements true bounded staleness).
+- ``Asynchronous``  — event-driven PS pushes: each worker advances its own
+                      CLOCK only on ticks where it has data (an all-zero
+                      mask means "no batch arrived at this worker"), and
+                      folds its delta into the shared global at its own
+                      clock cadence — uncoordinated progress expressed in
+                      one SPMD program.
+- ``SSP``           — same event-driven progress, but the staleness bound
+                      BINDS: a worker whose clock is ``staleness`` ahead of
+                      the slowest worker's (``lax.pmin`` over dp) is
+                      REFUSED its batch — the step leaves its state
+                      untouched and flags it not-accepted, and the host
+                      requeues the batch (host-driven pacing; the device
+                      enforces fastest − slowest ≤ s exactly like the host
+                      plane's clock-tracked SSP, protocols/sync.py).
+                      Per-worker clocks and accept flags live in the fleet
+                      state (``worker_clocks()`` / ``last_accepted()``).
 """
 
 from __future__ import annotations
@@ -117,6 +126,16 @@ class SPMDTrainer:
         self.batch_size = batch_size
         self.sync_every = int(self.tc.extra.get("syncEvery", 4))
         self.threshold = float(self.tc.extra.get("threshold", 0.5))
+        # SSP staleness bound s: fastest - slowest worker clock <= s
+        # (ref: the SSPWorker/SSPParameterServer pair, MLNodeGenerator.scala)
+        self.staleness = int(self.tc.extra.get("staleness", 3))
+        if protocol == "SSP" and self.staleness < 1:
+            # s=0 would refuse every batch at decision time (gap >= 0 is
+            # never < 0) and livelock the host's requeue loop; lockstep
+            # semantics are what Synchronous is for
+            raise ValueError(
+                f"SSP staleness must be >= 1, got {self.staleness}"
+            )
         default_alpha = 0.5 / max(self.dp, 1)
         self.alpha = float(self.tc.extra.get("alpha", default_alpha))
 
@@ -209,6 +228,11 @@ class SPMDTrainer:
             "step": izero.copy(),
             "syncs": izero.copy(),
             "cum_loss": zero.copy(),
+            # per-worker PROGRESS clock (ticks with data actually consumed)
+            # and the accept flag of the latest step — the SSP bound reads
+            # and the host's pacing/requeue decisions are driven by these
+            "clock": izero.copy(),
+            "accepted": stack(np.ones((self.dp,), np.float32)),
         }
 
     # --- the per-shard step ---
@@ -242,6 +266,8 @@ class SPMDTrainer:
         alpha = self.alpha
         n_workers = self.dp
 
+        staleness = self.staleness
+
         def step_fn(state, x, y, mask):
             # per-shard views: state leaves [1,1,...]; batch [1,B,D].
             # Inputs may arrive in a narrow feed dtype (float16 staging
@@ -256,6 +282,10 @@ class SPMDTrainer:
             step_i = _sq(state["step"])
             syncs = _sq(state["syncs"])
             cum_loss = _sq(state["cum_loss"])
+            clock = _sq(state["clock"])
+
+            old_params = params
+            old_preps = prep_states
 
             # preprocessors: online stats update + transform
             new_preps = []
@@ -271,6 +301,9 @@ class SPMDTrainer:
             flat = self._flat(params)
             step_i = step_i + 1
             at_cadence = (step_i % sync_every) == 0
+            has_data = jnp.sum(mask) > 0.0
+            # derived from mask so it carries the (dp, hub)-varying type
+            accepted = jnp.sum(mask) * 0.0 + 1.0
 
             if protocol == "Synchronous":
                 def do_sync(f, e, c, s):
@@ -316,10 +349,37 @@ class SPMDTrainer:
                     lambda f, e, c, s: (f, e, c, s),
                     flat, est, center, syncs,
                 )
-            else:  # Asynchronous / SSP: staggered folds into the shared global
-                w = jax.lax.axis_index("dp")
+            else:  # Asynchronous / SSP: event-driven progress + PS folds
+                # progress is per-worker: a worker only advances its clock
+                # on ticks where it has data; under SSP a worker whose
+                # clock is `staleness` ahead of the slowest is REFUSED the
+                # batch (state untouched, accepted=0) and the host requeues
+                # it — the bound binds across device steps, not just
+                # within a lockstep round
+                min_clock = jax.lax.pmin(clock, "dp")
+                if protocol == "SSP":
+                    allowed = jnp.logical_and(
+                        has_data, (clock - min_clock) < staleness
+                    )
+                else:
+                    allowed = has_data
+                accepted = allowed.astype(jnp.float32)
+                clock = clock + allowed.astype(jnp.int32)
+                # refused/idle workers keep their exact previous state
+                flat0 = self._flat(old_params)
+                flat = jnp.where(allowed, flat, flat0)
+                new_preps = [
+                    jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(allowed, new, old), s, s0
+                    )
+                    for s, s0 in zip(new_preps, old_preps)
+                ]
+                loss = jnp.where(allowed, loss, 0.0)
+                # PS push at the worker's own clock cadence; the collective
+                # itself runs unconditionally (SPMD), refused workers
+                # contribute zero
                 my_turn = jnp.logical_and(
-                    (step_i % sync_every) == (w % sync_every), step_i >= 1
+                    allowed, (clock % sync_every) == 0
                 )
                 contrib = jnp.where(my_turn, flat - est, jnp.zeros_like(flat))
                 # shared global accumulates mean deltas (PS fold), routed
@@ -329,8 +389,11 @@ class SPMDTrainer:
                 est = jnp.where(my_turn, center, est)
                 syncs = syncs + my_turn.astype(jnp.int32)
 
+            if protocol not in ("Asynchronous", "SSP"):
+                clock = clock + has_data.astype(jnp.int32)
+
             params = self._unflat(flat)
-            n = jnp.sum(mask)
+            n = jnp.sum(mask) * accepted
             cum_loss = cum_loss + loss * n
 
             new_state = {
@@ -343,6 +406,8 @@ class SPMDTrainer:
                 "step": _unsq(step_i),
                 "syncs": _unsq(syncs),
                 "cum_loss": _unsq(cum_loss),
+                "clock": _unsq(clock),
+                "accepted": _unsq(accepted),
             }
             return new_state, _unsq(loss)
 
@@ -407,7 +472,10 @@ class SPMDTrainer:
             def many_dense_impl(state, xs, ys):
                 def body(st, b):
                     x, y = b
-                    return self._step_fn(st, x, y, jnp.ones(y.shape, jnp.float32))
+                    # ones derived from y so the mask carries its
+                    # (dp, hub)-varying type
+                    ones = y.astype(jnp.float32) * 0.0 + 1.0
+                    return self._step_fn(st, x, y, ones)
 
                 return jax.lax.scan(body, state, (xs, ys))
 
@@ -433,6 +501,22 @@ class SPMDTrainer:
     @property
     def fitted(self) -> int:
         return self._fitted_host
+
+    def worker_clocks(self) -> np.ndarray:
+        """Per-worker progress clocks [dp] (ticks with data consumed)."""
+        return np.asarray(jax.device_get(self.state["clock"]))[:, 0]
+
+    def last_accepted(self) -> np.ndarray:
+        """Bool [dp]: whether each worker CONSUMED its batch on the latest
+        step. Under SSP a worker at the staleness bound refuses its batch;
+        the host must requeue it (and call :meth:`note_requeued` so fitted
+        counts only consumed rows)."""
+        return np.asarray(jax.device_get(self.state["accepted"]))[:, 0] > 0.0
+
+    def note_requeued(self, n_rows: int) -> None:
+        """Correct the fitted counter for rows a step refused (the host
+        counted them optimistically when it issued the step)."""
+        self._fitted_host -= int(n_rows)
 
     def curve_slice(self) -> List[Tuple[float, int]]:
         fresh = self._curve

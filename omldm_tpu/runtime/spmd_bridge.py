@@ -105,6 +105,13 @@ class SPMDBridge:
         if feed not in ("float32", "float16"):
             raise ValueError(f"feedDtype must be float32|float16, got {feed!r}")
         self.feed_dtype = np.dtype(feed)
+        # SSP paces per-worker progress: every launch must surface its
+        # accept flags so refused batches can be requeued — no chaining.
+        # Asynchronous CONSUMES every offered batch (allowed = has_data),
+        # so it keeps the chained bulk path and never checks flags.
+        self._paced = tc.protocol == "SSP"
+        if self._paced:
+            self.chain = 1
         self._stage_cap = self.chain * dp * b
         self._stage_x = np.zeros((self._stage_cap, dim), self.feed_dtype)
         self._stage_y = np.zeros((self._stage_cap,), self.feed_dtype)
@@ -223,24 +230,31 @@ class SPMDBridge:
             return
         b = self.config.batch_size
         group = self.dp * b
-        if full:
+        if full and not self._paced:
             xs = self._stage_x.reshape(self.chain, self.dp, b, self.dim)
             ys = self._stage_y.reshape(self.chain, self.dp, b)
             self.trainer.step_many_dense(xs, ys)
             self._stage_n = 0
             return
+        if self._paced:
+            # copy: refused batches re-enter the (reused) stage buffer
+            stage_x = self._stage_x[:n].copy()
+            stage_y = self._stage_y[:n].copy()
+        else:
+            stage_x = self._stage_x[:n]
+            stage_y = self._stage_y[:n]
+        self._stage_n = 0
         done = 0
         while n - done >= group:
+            xg = stage_x[done : done + group].reshape(self.dp, b, self.dim)
+            yg = stage_y[done : done + group].reshape(self.dp, b)
             self.trainer.step(
-                self._stage_x[done : done + group]
-                .reshape(self.dp, b, self.dim)
-                .astype(np.float32, copy=False),
-                self._stage_y[done : done + group]
-                .reshape(self.dp, b)
-                .astype(np.float32, copy=False),
+                xg.astype(np.float32, copy=False),
+                yg.astype(np.float32, copy=False),
                 np.ones((self.dp, b), np.float32),
                 valid_count=group,
             )
+            self._requeue_refused(xg, yg, None)
             done += group
         tail_b = min(b, TAIL_BATCH)
         tail_group = self.dp * tail_b
@@ -249,20 +263,63 @@ class SPMDBridge:
             x = np.zeros((tail_group, self.dim), np.float32)
             y = np.zeros((tail_group,), np.float32)
             mask = np.zeros((tail_group,), np.float32)
-            x[:rem] = self._stage_x[done : done + rem]
-            y[:rem] = self._stage_y[done : done + rem]
+            x[:rem] = stage_x[done : done + rem]
+            y[:rem] = stage_y[done : done + rem]
             mask[:rem] = 1.0
-            self.trainer.step(
-                x.reshape(self.dp, tail_b, self.dim),
-                y.reshape(self.dp, tail_b),
-                mask.reshape(self.dp, tail_b),
-                valid_count=rem,
+            # stripe rows across workers (row i -> slot i % dp); under SSP
+            # pacing, slots map SLOWEST-CLOCK-FIRST onto workers — the
+            # slowest worker always satisfies the bound, so every tail pass
+            # is guaranteed progress and short tails feed the laggards that
+            # gate min_clock instead of starving them
+            xg = np.ascontiguousarray(
+                x.reshape(tail_b, self.dp, self.dim).transpose(1, 0, 2)
             )
+            yg = np.ascontiguousarray(y.reshape(tail_b, self.dp).T)
+            mg = np.ascontiguousarray(mask.reshape(tail_b, self.dp).T)
+            if self._paced:
+                order = np.argsort(self.trainer.worker_clocks(), kind="stable")
+                inv = np.empty_like(order)
+                inv[order] = np.arange(self.dp)
+                xg, yg, mg = xg[inv], yg[inv], mg[inv]
+            self.trainer.step(xg, yg, mg, valid_count=rem)
+            self._requeue_refused(xg, yg, mg)
             done += rem
-        self._stage_n = 0
+
+    def _requeue_refused(self, xg, yg, mg) -> None:
+        """SSP pacing: re-stage the rows of workers whose batch the device
+        refused (staleness bound) and correct the fitted counter."""
+        if not self._paced:
+            return
+        acc = self.trainer.last_accepted()
+        if acc.all():
+            return
+        for w in np.nonzero(~acc)[0]:
+            rows = (
+                np.ones(yg.shape[1], bool) if mg is None else mg[w] > 0.0
+            )
+            k = int(rows.sum())
+            if k == 0:
+                continue
+            self.trainer.note_requeued(k)
+            self._stage_rows(
+                np.asarray(xg[w][rows], np.float32),
+                np.asarray(yg[w][rows], np.float32),
+            )
 
     def flush(self) -> None:
+        """Drain the stage. Under SSP pacing, refused rows re-enter the
+        stage; repeated passes are guaranteed progress (tail slots map
+        slowest-first, and the slowest worker always satisfies the bound),
+        so the drain terminates — the quiesce analogue of the host plane's
+        SSPParameterServer.on_terminate release."""
         self._train_staged()
+        while self._paced and self._stage_n:
+            before = self._stage_n
+            self._train_staged()
+            if self._stage_n >= before:
+                raise RuntimeError(
+                    "SSP flush made no progress draining refused rows"
+                )
 
     # --- query / termination path ---
 

@@ -200,3 +200,140 @@ class TestAsyncSharedGlobal:
                 # async/SSP replicas adopt the shared global on their turn;
                 # with syncEvery=1 every worker synced on the last step
                 assert float(np.linalg.norm(f - ref)) / scale < 0.35
+
+
+class TestBoundedStaleness:
+    """True SSP on the device plane: per-worker clocks advance only on
+    ticks with data; the staleness bound `fastest - slowest <= s` BINDS —
+    a too-fast worker's batch is refused (state untouched, accepted=0) and
+    the host requeues it. Ref: the SSPWorker/SSPParameterServer pair
+    (MLNodeGenerator.scala) and the host plane's clock-tracked SSP
+    (protocols/sync.py)."""
+
+    def _trainer(self, protocol, s):
+        mesh = make_mesh(dp=4, hub=1)
+        tc = TrainingConfiguration(
+            protocol=protocol,
+            extra={"syncEvery": 1, "staleness": s},
+        )
+        return SPMDTrainer(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}),
+            dim=6,
+            protocol=protocol,
+            mesh=mesh,
+            training_configuration=tc,
+            batch_size=16,
+        )
+
+    def _skewed_batch(self, dim=6, batch=16, seed=0):
+        """Only worker 0 has data this tick."""
+        rng = np.random.RandomState(seed)
+        x = rng.randn(4, batch, dim).astype(np.float32)
+        y = (x.sum(axis=2) > 0).astype(np.float32)
+        m = np.zeros((4, batch), np.float32)
+        m[0] = 1.0
+        return x, y, m
+
+    def test_ssp_bound_binds_under_skew(self):
+        s = 2
+        tr = self._trainer("SSP", s)
+        for t in range(8):  # worker 0 alone receives 8 batches
+            tr.step(*self._skewed_batch(seed=t), valid_count=16)
+        clocks = tr.worker_clocks()
+        # the bound stopped worker 0 at s; the excess batches were refused
+        assert clocks[0] == s, clocks
+        assert (clocks[1:] == 0).all(), clocks
+        acc = tr.last_accepted()
+        assert not acc[0]  # latest skewed batch was refused
+        # refused steps must leave params untouched: refusal implies the
+        # flag, and the fitted counter only moves via the host's accounting
+
+    def test_ssp_catchup_releases_fast_worker(self):
+        s = 2
+        tr = self._trainer("SSP", s)
+        for t in range(5):
+            tr.step(*self._skewed_batch(seed=t), valid_count=16)
+        assert tr.worker_clocks()[0] == s
+        # now everyone gets data: slow workers advance; worker 0 is still
+        # refused THIS tick (the bound reads clocks as of decision time)
+        # and released on the next
+        rng = np.random.RandomState(99)
+        x = rng.randn(4, 16, 6).astype(np.float32)
+        y = (x.sum(axis=2) > 0).astype(np.float32)
+        m = np.ones((4, 16), np.float32)
+        tr.step(x, y, m, valid_count=64)
+        clocks = tr.worker_clocks()
+        assert (clocks[1:] == 1).all(), clocks
+        assert clocks[0] == s  # gap still == s at decision time
+        assert not tr.last_accepted()[0]
+        tr.step(x, y, m, valid_count=64)
+        clocks = tr.worker_clocks()
+        assert clocks[0] == s + 1  # within bound again -> consumed
+        assert tr.last_accepted().all()
+
+    def test_async_has_no_bound(self):
+        """Asynchronous: the same skewed feed runs unbounded — the gap a
+        bound-off run reaches is exactly the violation SSP prevents."""
+        tr = self._trainer("Asynchronous", 2)
+        for t in range(8):
+            tr.step(*self._skewed_batch(seed=t), valid_count=16)
+        clocks = tr.worker_clocks()
+        assert clocks[0] == 8, clocks          # violation: gap 8 > s=2
+        assert (clocks[1:] == 0).all(), clocks
+        assert tr.last_accepted()[0]
+
+    def test_ssp_refused_batch_leaves_params_untouched(self):
+        s = 1
+        tr = self._trainer("SSP", s)
+        tr.step(*self._skewed_batch(seed=0), valid_count=16)  # clock 1, bound hit
+        import jax as _jax
+
+        before = _jax.device_get(tr.state["params"])
+        tr.step(*self._skewed_batch(seed=1), valid_count=16)  # refused
+        after = _jax.device_get(tr.state["params"])
+        assert not tr.last_accepted()[0]
+        for a, b in zip(
+            _jax.tree_util.tree_leaves(before), _jax.tree_util.tree_leaves(after)
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bridge_requeues_refused_rows(self):
+        """The streaming bridge repairs SSP refusals: refused rows re-enter
+        the stage and fitted counts only consumed rows."""
+        import json as _json
+
+        from omldm_tpu.config import JobConfig
+        from omldm_tpu.runtime import StreamJob
+        from omldm_tpu.runtime.job import REQUEST_STREAM
+
+        create = {
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "Softmax",
+                "hyperParameters": {"learningRate": 0.1, "nClasses": 2},
+                "dataStructure": {"nFeatures": 6},
+            },
+            "preProcessors": [],
+            "trainingConfiguration": {
+                "protocol": "SSP",
+                "engine": "spmd",
+                "extra": {"syncEvery": 1, "staleness": 2},
+            },
+        }
+        cfg = JobConfig(parallelism=4, batch_size=32, test=False)
+        job = StreamJob(cfg)
+        job.process_event(REQUEST_STREAM, _json.dumps(create))
+        [bridge] = job.spmd_bridges.values()
+        assert bridge._paced and bridge.chain == 1
+        rng = np.random.RandomState(0)
+        n = 3000
+        x = rng.randn(n, 6).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.float32)
+        job.process_packed_batch(x, y, np.zeros(n, np.uint8))
+        bridge.flush()
+        tr = bridge.trainer
+        clocks = tr.worker_clocks()
+        assert clocks.max() - clocks.min() <= 2, clocks
+        # fitted never exceeds the rows offered
+        assert tr.fitted <= n
