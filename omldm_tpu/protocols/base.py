@@ -85,6 +85,12 @@ class WorkerNode:
     def toggle(self) -> None:
         self.paused = not self.paused
 
+    def set_parallelism(self, n_workers: int) -> None:
+        """Live rescale: the runtime bumped the worker count mid-job (the
+        reference's shared ``spokeParallelism: IntWrapper``,
+        FlinkSpoke.scala:31,345-348)."""
+        self.n_workers = n_workers
+
 
 class HubNode:
     """Hub-side protocol node owning global protocol state + statistics."""
@@ -135,6 +141,34 @@ class HubNode:
         """Accumulate (loss, fitted) learning-curve points pushed by workers
         (FlinkHub.scala:101-116 extracts these from the PS)."""
         self.stats.extend_curve(slices)
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Live rescale: update the expected worker count.
+
+        ``_fitted_seen`` (the per-worker fitted watermark behind the delta
+        counting every built-in PS uses) FOLDS into the survivor
+        ``w % n_workers`` instead of being dropped: a shrink merges the
+        retired replica's pipeline — fitted counter included — into that
+        survivor (StreamJob.rescale), so its next push reports own+retired
+        fitted; folding the watermark keeps the delta equal to the
+        genuinely unreported remainder.
+
+        Protocols with worker-keyed BARRIER state (rounds, clocks, polls)
+        MUST override this (calling super) to prune retired workers' round
+        entries and re-evaluate any barrier that the lowered count now
+        satisfies — the check otherwise only runs inside receive(), which
+        may never fire again if every survivor is already waiting."""
+        self.n_workers = n_workers
+        seen = getattr(self, "_fitted_seen", None)
+        if isinstance(seen, dict):
+            for w in [w for w in seen if isinstance(w, int) and w >= n_workers]:
+                seen[w % n_workers] = seen.get(w % n_workers, 0) + seen.pop(w)
+
+    @staticmethod
+    def _prune_retired(d: dict, n_workers: int) -> None:
+        """Drop worker-keyed entries owned by retired workers (id >= n)."""
+        for w in [w for w in d if isinstance(w, int) and w >= n_workers]:
+            del d[w]
 
     def receive(self, worker_id: int, op: str, payload: Any) -> None:
         raise NotImplementedError

@@ -117,27 +117,41 @@ class FGMParameterServer(HubNode):
         elif op == OP_ZETA and "phi" in payload:
             self.count_received(payload)
             self._phis[worker_id] = payload["phi"]
-            if self._polling and len(self._phis) >= self.n_workers:
-                self._polling = False
-                psi = sum(self._phis.values())
-                if psi >= 0:
-                    # safe zone breached: full synchronization round
-                    self._collecting = True
-                    self._collected.clear()
-                    self.count_shipped({"pull": True}, n_dest=self.n_workers)
-                    self.broadcast(OP_PULL, {})
-                else:
-                    # still safe: new subround with a tighter quantum
-                    self.subrounds += 1
-                    self._global_counter = 0
-                    theta = -psi / (2.0 * self.n_workers)
-                    self.count_shipped({"theta": theta}, n_dest=self.n_workers)
-                    self.broadcast(OP_UPDATE, {"params": None, "theta": theta})
+            self._maybe_finish_poll()
         elif op == OP_PUSH:
             self._account(worker_id, payload)
             self._collected[worker_id] = payload["params"]
             if len(self._collected) >= self.n_workers:
                 self._finish_round()
+
+    def _maybe_finish_poll(self) -> None:
+        if self._polling and len(self._phis) >= self.n_workers:
+            self._polling = False
+            psi = sum(self._phis.values())
+            if psi >= 0:
+                # safe zone breached: full synchronization round
+                self._collecting = True
+                self._collected.clear()
+                self.count_shipped({"pull": True}, n_dest=self.n_workers)
+                self.broadcast(OP_PULL, {})
+            else:
+                # still safe: new subround with a tighter quantum
+                self.subrounds += 1
+                self._global_counter = 0
+                theta = -psi / (2.0 * self.n_workers)
+                self.count_shipped({"theta": theta}, n_dest=self.n_workers)
+                self.broadcast(OP_UPDATE, {"params": None, "theta": theta})
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Pruning retired workers can complete a pending poll or collection
+        round; re-evaluate both barriers here (receive() may never fire
+        again if every survivor is waiting)."""
+        super().set_parallelism(n_workers)
+        self._prune_retired(self._phis, n_workers)
+        self._prune_retired(self._collected, n_workers)
+        self._maybe_finish_poll()
+        if self._collecting and len(self._collected) >= n_workers:
+            self._finish_round()
 
     def _finish_round(self) -> None:
         stacked = np.stack(list(self._collected.values()))

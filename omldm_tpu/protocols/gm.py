@@ -91,6 +91,14 @@ class GMParameterServer(HubNode):
             if len(self._collected) >= self.n_workers:
                 self._finish_round()
 
+    def set_parallelism(self, n_workers: int) -> None:
+        """A pruned collection round may already be complete; finish it here
+        since every survivor might be blocked waiting on the broadcast."""
+        super().set_parallelism(n_workers)
+        self._prune_retired(self._collected, n_workers)
+        if self._collecting and len(self._collected) >= n_workers:
+            self._finish_round()
+
     def _finish_round(self) -> None:
         stacked = np.stack(list(self._collected.values()))
         self.global_params = stacked.mean(axis=0)

@@ -73,6 +73,9 @@ class SynchronousParameterServer(HubNode):
             return
         self._account(worker_id, payload)
         self._round[worker_id] = payload["params"]
+        self._maybe_finish_round()
+
+    def _maybe_finish_round(self) -> None:
         if len(self._round) >= self.n_workers:
             stacked = np.stack(list(self._round.values()))
             self.global_params = stacked.mean(axis=0)
@@ -83,6 +86,14 @@ class SynchronousParameterServer(HubNode):
                 models=self.n_workers if self.hub_id == 0 else 0,
             )
             self.broadcast(OP_UPDATE, self.global_params)
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Shrink may leave the pruned round already complete — with every
+        survivor waiting on the barrier, receive() would never run again,
+        so the barrier re-check happens here."""
+        super().set_parallelism(n_workers)
+        self._prune_retired(self._round, n_workers)
+        self._maybe_finish_round()
 
     def on_terminate(self) -> None:
         # release any round stuck behind a straggler that quiesced
@@ -176,6 +187,15 @@ class SSPParameterServer(HubNode):
                     self.global_params, models=1 if self.hub_id == 0 else 0
                 )
                 self.reply(w, OP_UPDATE, {"params": self.global_params, "wait": False})
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Retired clocks leave the staleness window; re-evaluate releases
+        (a survivor may only have been waiting on a retired straggler)."""
+        super().set_parallelism(n_workers)
+        self._prune_retired(self._clocks, n_workers)
+        self._prune_retired(self._waiting, n_workers)
+        if self.global_params is not None:
+            self._release_unblocked()
 
     def on_terminate(self) -> None:
         # release everything at quiesce

@@ -111,6 +111,13 @@ class HubManager:
                 hub.receive(worker_id, op, payload)
         return hub
 
+    def set_parallelism(self, n_workers: int) -> None:
+        """Live rescale: every PS shard updates its expected worker count
+        and drops retired workers' round state (the reference's shared
+        spokeParallelism IntWrapper reaches hub logic the same way)."""
+        for hub in self.hubs.values():
+            hub.node.set_parallelism(n_workers)
+
     def delete_network(self, network_id: int) -> None:
         for key in [k for k in self.hubs if k[0] == network_id]:
             del self.hubs[key]

@@ -16,6 +16,7 @@ Kafka sources, with the same termination protocol driven by a silence timer.
 from __future__ import annotations
 
 import collections
+import copy
 import dataclasses
 import time
 from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
@@ -141,6 +142,8 @@ class StreamJob:
     def _reply_to_spoke(
         self, network_id: int, hub_id: int, worker_id: int, op: str, payload: Any
     ) -> None:
+        if worker_id >= len(self.spokes):
+            return  # addressed to a worker retired by a live rescale
         self.spokes[worker_id].receive_from_hub(network_id, hub_id, op, payload)
 
     # --- event handling ---
@@ -314,6 +317,85 @@ class StreamJob:
         for h in range(request.training_configuration.hub_parallelism):
             self.hub_manager.create_hub(request, h, dim)
         self._replay_backlog()
+
+    def rescale(self, n_new: int) -> None:
+        """LIVE parallelism change, mid-stream, no restart — the runtime
+        analogue of the reference's elastic rescale (spokeParallelism bump +
+        wrapper merge + mergingDataBuffers, FlinkSpoke.scala:345-348,
+        SpokeLogic.scala:37-50):
+
+        - grow: new spokes spawn, every live host-plane pipeline deploys on
+          them (fresh replicas sync through their protocol's next round);
+        - shrink: retiring spokes merge into survivor ``id % n_new`` —
+          model replicas via the learner merge hook, pending batcher rows
+          re-fed, holdout sets interleaved, pre-creation buffers carried;
+        - every surviving node and PS shard learns the new worker count
+          (barrier counts, termination countdown, score normalization all
+          follow config.parallelism).
+
+        SPMD-engine pipelines keep their device mesh (dp is bound to
+        hardware, not to the virtual worker count)."""
+        p = len(self.spokes)
+        if n_new == p:
+            return
+        if n_new < 1:
+            raise ValueError(f"parallelism must be >= 1, got {n_new}")
+        if n_new > p:
+            for w in range(p, n_new):
+                self.spokes.append(
+                    Spoke(
+                        worker_id=w,
+                        config=self.config,
+                        send_to_hub=self.hub_manager.route,
+                        emit_prediction=self._emit_prediction,
+                        emit_response=self._route_response_fragment,
+                        on_poll=self.stats.mark_activity,
+                    )
+                )
+            self.config.parallelism = n_new
+            # deploy live host-plane pipelines on the new workers
+            for net_id, request in self.pipeline_manager.node_map.items():
+                if net_id in self.spmd_bridges:
+                    continue
+                dim = self._dims.get(net_id)
+                if dim is None:
+                    continue
+                src = self.spokes[0].nets.get(net_id)
+                deploy = request
+                if src is not None:
+                    # pin the RESOLVED protocol: a pipeline created at
+                    # parallelism 1 was forced to CentralizedTraining
+                    # (FlinkSpoke.scala:213-215); re-resolving the original
+                    # request at the new parallelism would hand new workers
+                    # a different protocol than the live hub speaks
+                    deploy = dataclasses.replace(
+                        request,
+                        training_configuration=dataclasses.replace(
+                            request.training_configuration,
+                            protocol=src.protocol,
+                        ),
+                    )
+                for w in range(p, n_new):
+                    self.spokes[w].handle_request(deploy, dim)
+                    dst = self.spokes[w].nets.get(net_id)
+                    if src is None or dst is None:
+                        continue
+                    # seed the new replica from the fleet's current model:
+                    # a fresh-init replica would drag the next averaging
+                    # round halfway back toward initialization
+                    state = copy.deepcopy(src.pipeline.state)
+                    state["fitted"] = dst.pipeline.state["fitted"]
+                    state["cum_loss"] = dst.pipeline.state["cum_loss"]
+                    dst.pipeline.state = state
+        else:
+            survivors, retired = self.spokes[:n_new], self.spokes[n_new:]
+            self.config.parallelism = n_new
+            for r in retired:
+                survivors[r.worker_id % n_new].absorb(r)
+            self.spokes = survivors
+        for spoke in self.spokes:
+            spoke.set_parallelism(n_new)
+        self.hub_manager.set_parallelism(n_new)
 
     def _handle_data(self, inst: DataInstance) -> None:
         self.stats.mark_activity()

@@ -417,6 +417,48 @@ class Spoke:
         if net is not None:
             net.node.receive(op, payload, hub_id)
 
+    # --- live rescale (FlinkSpoke.scala:345-348, SpokeLogic.scala:37-50) ---
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Propagate a live parallelism change to every hosted node."""
+        for net in self.nets.values():
+            net.node.set_parallelism(n_workers)
+
+    def absorb(self, retired: "Spoke") -> None:
+        """Merge a retiring spoke's state into this one (shrink rescale):
+        model replicas merge via the learner merge hook, pending batcher
+        rows re-enter this spoke's batchers, holdout sets interleave, and
+        pre-creation buffers concatenate — the mergingDataBuffers +
+        wrapper-merge semantics of the reference's rescale path
+        (SpokeLogic.scala:37-50, FlinkSpoke.scala:289-330)."""
+        for net_id, rnet in retired.nets.items():
+            snet = self.nets.get(net_id)
+            if snet is None:
+                # this spoke never hosted the pipeline (shouldn't happen in
+                # a job-managed rescale): adopt the retiring replica whole
+                self.nets[net_id] = rnet
+                continue
+            # pending micro-batch rows train into the surviving replica
+            pending = rnet.batcher.drain()
+            if pending is not None:
+                px, py = pending
+                i = 0
+                while i < px.shape[0]:
+                    i += snet.batcher.add_many(px[i:], py[i:])
+                    if snet.batcher.full:
+                        snet.flush_batch()
+            snet.pipeline.merge_from([rnet.pipeline])
+            # holdout windows interleave (keep-newest overflow), the same
+            # merge the reference's rescale uses (CommonUtils.scala:36-48)
+            snet.test_set.merge([rnet.test_set])
+            snet.holdout_count += rnet.holdout_count
+        # pre-creation buffers carry over
+        self.record_buffer.merge([retired.record_buffer])
+        for block in retired._packed_buffer:
+            self._packed_buffer.append(block)
+            self._packed_buffered_rows += block[0].shape[0]
+        self._poll_counter += retired._poll_counter
+
     def mean_buffer_size(self) -> float:
         """getMeanBufferSize analogue (FlinkSpoke.scala:138): mean pending
         (unfitted) records across hosted pipelines."""

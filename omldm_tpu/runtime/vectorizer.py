@@ -97,6 +97,16 @@ class MicroBatcher:
             self._n += take
         return take
 
+    def drain(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Return the UNPADDED pending rows (x[:n], y[:n]) and reset; None
+        if empty. Used by rescale merges, which re-feed the rows into
+        another batcher rather than training a padded batch."""
+        if self._n == 0:
+            return None
+        out = self._x[: self._n].copy(), self._y[: self._n].copy()
+        self._n = 0
+        return out
+
     def flush(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Return the padded (x, y, mask) batch and reset; None if empty."""
         if self._n == 0:

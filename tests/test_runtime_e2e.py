@@ -359,3 +359,131 @@ class TestPreCreateBacklog:
         # newest rows kept (partial trim, not a whole-entry drop)
         assert kind == "packed" and float(bx[-1, 0]) == float(n - 1)
         assert float(bx[0, 0]) == 5000.0
+
+
+class TestLiveRescale:
+    """Mid-stream parallelism changes without restart (the reference's
+    elastic rescale: spokeParallelism bump + wrapper merge +
+    mergingDataBuffers, FlinkSpoke.scala:345-348, SpokeLogic.scala:37-50)."""
+
+    def _create(self, protocol="Synchronous"):
+        return {
+            "id": 0,
+            "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "preProcessors": [],
+            "trainingConfiguration": {"protocol": protocol},
+        }
+
+    def test_train_through_4_8_2_without_restart(self):
+        cfg = JobConfig(parallelism=4, batch_size=64, test_set_size=64)
+        job = StreamJob(cfg)
+        lines, x, y, w = make_stream(9000, dim=8)
+        job.process_event(REQUEST_STREAM, json.dumps(self._create()))
+        it = iter(lines)
+        for _ in range(3000):
+            job.process_event(TRAINING_STREAM, next(it))
+        job.rescale(8)
+        assert len(job.spokes) == 8
+        # every worker (old and new) hosts the pipeline with n_workers=8
+        for s in job.spokes:
+            assert 0 in s.nets
+            assert s.nets[0].node.n_workers == 8
+        for _ in range(3000):
+            job.process_event(TRAINING_STREAM, next(it))
+        job.rescale(2)
+        assert len(job.spokes) == 2
+        for _ in range(3000):
+            job.process_event(TRAINING_STREAM, next(it))
+        # drive termination: countdown must use the CURRENT parallelism (2)
+        report = job.run([])
+        assert report is not None and job.stats.terminated
+        [stats] = report.statistics
+        # loss continuity: all three phases' records trained somewhere —
+        # and none double-counted through the shrink merge (the fitted
+        # watermark folds into the survivor, protocols/base.py)
+        assert 7000 < stats.fitted <= 9000
+        assert stats.score > 0.85
+
+    def test_shrink_merges_pending_rows_and_holdout(self):
+        cfg = JobConfig(parallelism=4, batch_size=256, test_set_size=32)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(1000, dim=8, seed=3)
+        job.process_event(REQUEST_STREAM, json.dumps(self._create()))
+        for l in lines:
+            job.process_event(TRAINING_STREAM, l)
+        pending = sum(len(s.nets[0].batcher) for s in job.spokes)
+        holdout = sum(len(s.nets[0].test_set) for s in job.spokes)
+        fitted_before = sum(s.nets[0].pipeline.fitted for s in job.spokes)
+        assert pending > 0
+        job.rescale(1)
+        [spoke] = job.spokes
+        # pending rows from retired spokes re-entered the survivor (either
+        # still pending or already trained when a batch filled)
+        assert len(spoke.nets[0].batcher) + spoke.nets[0].pipeline.fitted >= (
+            pending + fitted_before
+        )
+        # survivor's sliding holdout absorbed retired points up to capacity
+        assert len(spoke.nets[0].test_set) == min(holdout, 32)
+
+    def test_grow_then_query_counts_all_workers(self):
+        cfg = JobConfig(parallelism=2, batch_size=64, test_set_size=32)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(2000, dim=8, seed=4)
+        job.process_event(REQUEST_STREAM, json.dumps(self._create()))
+        for l in lines[:1000]:
+            job.process_event(TRAINING_STREAM, l)
+        job.rescale(4)
+        for l in lines[1000:]:
+            job.process_event(TRAINING_STREAM, l)
+        query = {"id": 0, "request": "Query", "requestId": 7}
+        job.process_event(REQUEST_STREAM, json.dumps(query))
+        merged = [r for r in job.responses if r.response_id == 7]
+        # the merger waited for all 4 workers' fragment sets
+        assert merged, "no merged query response after rescale"
+
+    def test_shrink_mid_round_does_not_freeze_training(self):
+        """Shrinking while a sync round is half-complete must re-evaluate
+        the hub barrier — otherwise every survivor waits forever and live
+        training freezes (regression)."""
+        cfg = JobConfig(parallelism=4, batch_size=32, test_set_size=16)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(6000, dim=6, seed=8)
+        job.process_event(
+            REQUEST_STREAM, json.dumps(self._create("Synchronous"))
+        )
+        it = iter(lines)
+        for _ in range(2000):
+            job.process_event(TRAINING_STREAM, next(it))
+        fitted_mid = sum(s.nets[0].pipeline.fitted for s in job.spokes)
+        job.rescale(2)
+        for _ in range(4000):
+            job.process_event(TRAINING_STREAM, next(it))
+        fitted_end = sum(s.nets[0].pipeline.fitted for s in job.spokes)
+        # live training kept flowing after the shrink
+        assert fitted_end > fitted_mid + 2000, (fitted_mid, fitted_end)
+
+    def test_grow_from_parallelism_one_keeps_resolved_protocol(self):
+        """A pipeline created at parallelism 1 was forced to
+        CentralizedTraining (FlinkSpoke.scala:213-215); growing must deploy
+        the SAME resolved protocol on new workers, not re-resolve the
+        original request against the new parallelism (regression: new
+        SynchronousWorkers waiting on a SimplePS hub froze)."""
+        cfg = JobConfig(parallelism=1, batch_size=32, test_set_size=16)
+        job = StreamJob(cfg)
+        lines, *_ = make_stream(6000, dim=6, seed=9)
+        job.process_event(
+            REQUEST_STREAM, json.dumps(self._create("Synchronous"))
+        )
+        it = iter(lines)
+        for _ in range(1000):
+            job.process_event(TRAINING_STREAM, next(it))
+        job.rescale(4)
+        protos = {s.nets[0].protocol for s in job.spokes}
+        assert protos == {"CentralizedTraining"}, protos
+        for _ in range(5000):
+            job.process_event(TRAINING_STREAM, next(it))
+        for s in job.spokes:
+            assert s.nets[0].pipeline.fitted > 500, (
+                s.worker_id, s.nets[0].pipeline.fitted
+            )
