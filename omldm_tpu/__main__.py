@@ -14,7 +14,8 @@ Sources (choose one style):
 - ``--trainingData path.jsonl`` / ``--forecastingData path.jsonl`` /
   ``--requests path.jsonl`` — JSON-lines file replay, round-robin
   interleaved (the deterministic stand-in for stream union, Job.scala:70);
-  an ``EOS`` line stops a file (DataInstanceParser.scala:14).
+  ``EOS`` marker lines are dropped and replay continues, matching the
+  reference parser (DataInstanceParser.scala:13-21).
 - ``--events combined.jsonl`` — one fully-ordered file of
   ``{"stream": "trainingData"|"forecastingData"|"requests", "data": {...}}``
   lines, when the exact arrival order matters (e.g. Query after training).
@@ -243,6 +244,11 @@ def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
                     req.training_configuration.extra.get("hashDims", 0)
                 )
                 ds = req.learner.data_structure if req.learner else None
+                if ds and ds.get("sparse"):
+                    # sparse pipelines featurize per record into padded COO
+                    # (SparseVectorizer); the dense C++ block parser cannot
+                    # feed them a wide hashed index space
+                    return None
                 if ds and "nFeatures" in ds:
                     return int(ds["nFeatures"]) + hash_dims, hash_dims
                 # first Create without an explicit width: infer from data

@@ -50,6 +50,21 @@ def is_valid_learner(name: str) -> bool:
 def make_learner(spec: LearnerSpec) -> Learner:
     """Instantiate a learner from a request's LearnerSpec; raises KeyError on
     unknown names (the control plane validates against the allowlist first,
-    PipelineMap.scala:22-47)."""
+    PipelineMap.scala:22-47).
+
+    ``dataStructure: {"sparse": true}`` selects the padded-COO sparse
+    variant of the linear learners (the reference's SparseVector inputs,
+    DataPointParser.scala:4,20-47) — inputs arrive as (idx, val) pairs and
+    updates are gather/scatter over a dense device weight vector."""
+    if spec.data_structure and spec.data_structure.get("sparse"):
+        from omldm_tpu.learners.sparse_linear import SPARSE_LEARNERS
+
+        cls = SPARSE_LEARNERS.get(spec.name)
+        if cls is None:
+            raise KeyError(
+                f"learner {spec.name!r} has no sparse variant "
+                f"(available: {sorted(SPARSE_LEARNERS)})"
+            )
+        return cls(spec.hyper_parameters, spec.data_structure)
     cls = LEARNERS[spec.name]
     return cls(spec.hyper_parameters, spec.data_structure)

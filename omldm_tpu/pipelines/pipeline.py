@@ -49,6 +49,12 @@ class MLPipeline:
         self.preps: List[Preprocessor] = [
             make_preprocessor(p) for p in preprocessor_specs
         ]
+        if getattr(self.learner, "sparse", False) and self.preps:
+            raise ValueError(
+                "sparse learners consume raw (idx, val) batches; dense "
+                "preprocessors cannot apply — drop preProcessors or use "
+                "the dense learner variant"
+            )
         self.dim = dim
         self.per_record = per_record
         # feature dim after each preprocessor

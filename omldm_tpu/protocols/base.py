@@ -91,6 +91,13 @@ class WorkerNode:
         FlinkSpoke.scala:31,345-348)."""
         self.n_workers = n_workers
 
+    def on_model_seeded(self) -> None:
+        """The runtime replaced this node's pipeline state wholesale (grow
+        rescale seeds new replicas from the fleet model). Protocols that
+        snapshot a drift baseline re-anchor here — otherwise the seeded
+        params register as drift from the stale (init) estimate and fire a
+        spurious synchronization."""
+
 
 class HubNode:
     """Hub-side protocol node owning global protocol state + statistics."""

@@ -45,6 +45,10 @@ class FGMWorker(SyncingWorker):
     def on_start(self) -> None:
         self._estimate = self.get_flat()
 
+    def on_model_seeded(self) -> None:
+        # re-anchor the drift baseline at the seeded fleet model
+        self._estimate = self.get_flat()
+
     def _phi(self) -> float:
         current = self.get_flat()
         est = self._estimate if self._estimate is not None else np.zeros_like(current)

@@ -33,6 +33,10 @@ class GMWorker(SyncingWorker):
     def on_start(self) -> None:
         self._estimate = self.get_flat()
 
+    def on_model_seeded(self) -> None:
+        # re-anchor the drift baseline at the seeded fleet model
+        self._estimate = self.get_flat()
+
     def on_sync_point(self) -> None:
         if self._violated:
             return  # already reported this round; wait for collection

@@ -38,6 +38,11 @@ class PipelineManager:
                     return f"unknown preprocessor {p.name!r}"
             if request.training_configuration.hub_parallelism < 1:
                 return "HubParallelism must be >= 1"
+            ds = request.learner.data_structure or {}
+            if ds.get("sparse") and "nFeatures" not in ds:
+                # the wide hashed index space cannot be inferred from the
+                # first record (SparseVectorizer needs the model width)
+                return "sparse learners require dataStructure.nFeatures"
             return None
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
@@ -45,6 +50,11 @@ class PipelineManager:
             if request.request == RequestType.UPDATE:
                 if request.learner is None or not is_valid_learner(request.learner.name):
                     return "invalid update learner"
+                ds = request.learner.data_structure or {}
+                if ds.get("sparse") and "nFeatures" not in ds:
+                    # same rule as Create: a reused/inferred narrow dim
+                    # would make the hashed index space negative
+                    return "sparse learners require dataStructure.nFeatures"
             return None
         return f"unknown request type {request.request}"
 

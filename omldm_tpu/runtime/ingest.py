@@ -17,14 +17,19 @@ from omldm_tpu.api.data import EOS
 
 
 def file_events(path: str, stream: str) -> Iterator[Tuple[str, str]]:
-    """Replay a JSON-lines file as (stream, line) events; stops at EOS."""
+    """Replay a JSON-lines file as (stream, line) events.
+
+    ``"EOS"`` markers are DROPPED and replay continues — the reference's
+    parser swallows them mid-stream (DataInstanceParser.scala:13-21), and
+    the C++ bulk path does the same (fastparse.cpp); terminating here would
+    silently truncate a stream that embeds markers."""
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             if line == EOS or line == f'"{EOS}"':
-                break
+                continue
             yield (stream, line)
 
 

@@ -387,6 +387,10 @@ class StreamJob:
                     state["fitted"] = dst.pipeline.state["fitted"]
                     state["cum_loss"] = dst.pipeline.state["cum_loss"]
                     dst.pipeline.state = state
+                    # drift-monitoring workers re-anchor their baseline at
+                    # the seeded model (a stale init-time estimate would
+                    # register the seed itself as drift and fire a sync)
+                    dst.node.on_model_seeded()
         else:
             survivors, retired = self.spokes[:n_new], self.spokes[n_new:]
             self.config.parallelism = n_new

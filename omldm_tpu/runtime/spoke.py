@@ -31,7 +31,12 @@ from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
 from omldm_tpu.runtime.databuffers import DataSet
 from omldm_tpu.runtime.messages import OP_PUSH
-from omldm_tpu.runtime.vectorizer import MicroBatcher, Vectorizer
+from omldm_tpu.runtime.vectorizer import (
+    MicroBatcher,
+    SparseMicroBatcher,
+    SparseVectorizer,
+    Vectorizer,
+)
 
 # width of the immediate-serving predict batch (forecasting records are padded
 # into this fixed shape so the predict jit never recompiles)
@@ -56,9 +61,21 @@ class SpokeNet:
         self.protocol = resolve_protocol(
             tc.protocol, request.learner.name, n_workers
         )
-        hash_dims = int(tc.extra.get("hashDims", 0))
-        self.vectorizer = Vectorizer(dim, hash_dims)
+        ds = (request.learner.data_structure or {}) if request.learner else {}
+        self.sparse = bool(ds.get("sparse"))
         batch = int(tc.mini_batch_size or config.batch_size)
+        if self.sparse:
+            # padded-COO featurization: dense slots + hashed categoricals
+            # in a wide index space (SparseVector parity,
+            # DataPointParser.scala:4,20-47)
+            self.max_nnz = int(ds.get("maxNnz", 64))
+            hash_space = int(ds.get("hashSpace", 0))
+            self.vectorizer = SparseVectorizer(dim, hash_space, self.max_nnz)
+            self.batcher = SparseMicroBatcher(self.max_nnz, batch)
+        else:
+            hash_dims = int(tc.extra.get("hashDims", 0))
+            self.vectorizer = Vectorizer(dim, hash_dims)
+            self.batcher = MicroBatcher(dim, batch)
         pipeline = MLPipeline(
             request.learner,
             request.preprocessors,
@@ -69,7 +86,6 @@ class SpokeNet:
         self.node = make_worker_node(
             self.protocol, pipeline, worker_id, n_workers, tc, send
         )
-        self.batcher = MicroBatcher(dim, batch)
         self.test_set: DataSet[Tuple[np.ndarray, float]] = DataSet(
             config.test_set_size
         )
@@ -85,11 +101,17 @@ class SpokeNet:
             x, y, mask = flushed
             self.node.on_training_batch(x, y, mask)
 
-    def test_arrays(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    def test_arrays(self) -> Optional[Tuple[Any, np.ndarray, np.ndarray]]:
         if self.test_set.is_empty:
             return None
         pts = self.test_set.to_list()
-        x = np.stack([p[0] for p in pts])
+        if self.sparse:
+            x = (
+                np.stack([p[0][0] for p in pts]),
+                np.stack([p[0][1] for p in pts]),
+            )
+        else:
+            x = np.stack([p[0] for p in pts])
         y = np.asarray([p[1] for p in pts], np.float32)
         return x, y, np.ones((len(pts),), np.float32)
 
@@ -267,9 +289,30 @@ class Spoke:
         out[:, :w] = rows
         return out
 
+    @staticmethod
+    def _dense_rows_to_coo(rows: np.ndarray, max_nnz: int):
+        """Dense packed rows -> per-row padded COO (for sparse nets fed by
+        the dense bulk-ingest path; nnz beyond the budget truncates)."""
+        n = rows.shape[0]
+        idx = np.zeros((n, max_nnz), np.int32)
+        val = np.zeros((n, max_nnz), np.float32)
+        for i in range(n):
+            nz = np.nonzero(rows[i])[0][:max_nnz]
+            idx[i, : nz.size] = nz
+            val[i, : nz.size] = rows[i, nz]
+        return idx, val
+
     def _train_packed(self, net: SpokeNet, tx: np.ndarray, ty: np.ndarray) -> None:
         n = tx.shape[0]
         if n == 0:
+            return
+        if net.sparse:
+            # the packed stream is dense-featured; sparse nets re-sparsify
+            # row by row (categorical-rich lines take the per-record path
+            # upstream, __main__._packed_training_source)
+            sidx, sval = self._dense_rows_to_coo(tx, net.max_nnz)
+            for i in range(n):
+                self._train(net, (sidx[i], sval[i]), float(ty[i]))
             return
         tx = self._adapt_width(tx, net.dim)
         if self.config.test:
@@ -308,6 +351,15 @@ class Spoke:
     def _serve_packed(
         self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
     ) -> None:
+        if net.sparse:
+            sidx, sval = self._dense_rows_to_coo(x[f_idx], net.max_nnz)
+            for j in range(f_idx.size):
+                inst = DataInstance(
+                    numerical_features=x[int(f_idx[j])].tolist(),
+                    operation=FORECASTING,
+                )
+                self._serve(net, inst, (sidx[j], sval[j]))
+            return
         rows = self._adapt_width(x[f_idx], net.dim)
         for s in range(0, f_idx.size, PREDICT_BATCH):
             chunk = rows[s : s + PREDICT_BATCH]
@@ -323,7 +375,7 @@ class Spoke:
                     Prediction(net.request.id, inst, float(preds[j]))
                 )
 
-    def _train(self, net: SpokeNet, x: np.ndarray, y: float) -> None:
+    def _train(self, net: SpokeNet, x, y: float) -> None:
         # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
         c = net.holdout_count % 10
         net.holdout_count += 1
@@ -332,13 +384,22 @@ class Spoke:
             if evicted is None:
                 return
             x, y = evicted
-        net.batcher.add(x, y)
+        if net.sparse:
+            net.batcher.add(x[0], x[1], y)
+        else:
+            net.batcher.add(x, y)
         if net.batcher.full:
             net.flush_batch()
 
-    def _serve(self, net: SpokeNet, inst: DataInstance, x: np.ndarray) -> None:
-        xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
-        xb[0] = x
+    def _serve(self, net: SpokeNet, inst: DataInstance, x) -> None:
+        if net.sparse:
+            ib = np.zeros((PREDICT_BATCH, net.max_nnz), np.int32)
+            vb = np.zeros((PREDICT_BATCH, net.max_nnz), np.float32)
+            ib[0], vb[0] = x
+            xb = (ib, vb)
+        else:
+            xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+            xb[0] = x
         preds = net.node.on_forecast_batch(xb)
         self._emit_prediction(
             Prediction(net.request.id, inst, float(preds[0]))
@@ -438,15 +499,35 @@ class Spoke:
                 # a job-managed rescale): adopt the retiring replica whole
                 self.nets[net_id] = rnet
                 continue
-            # pending micro-batch rows train into the surviving replica
-            pending = rnet.batcher.drain()
-            if pending is not None:
-                px, py = pending
-                i = 0
-                while i < px.shape[0]:
-                    i += snet.batcher.add_many(px[i:], py[i:])
-                    if snet.batcher.full:
-                        snet.flush_batch()
+            # pending rows train into the surviving replica: the batcher's
+            # partial fill AND any batches the retiring node buffered while
+            # waiting on a protocol sync (SyncingWorker._blocked — dropping
+            # them would break the rescale loss-continuity guarantee)
+            pending = [rnet.batcher.drain()]
+            for bx, by, bm in getattr(rnet.node, "_blocked", []):
+                valid = np.asarray(bm) > 0.0
+                if rnet.sparse:
+                    bi, bv = bx
+                    pending.append(((np.asarray(bi)[valid],
+                                     np.asarray(bv)[valid]),
+                                    np.asarray(by)[valid]))
+                else:
+                    pending.append((np.asarray(bx)[valid], np.asarray(by)[valid]))
+            for entry in pending:
+                if entry is None:
+                    continue
+                px, py = entry
+                if rnet.sparse:
+                    for i in range(py.shape[0]):
+                        snet.batcher.add(px[0][i], px[1][i], float(py[i]))
+                        if snet.batcher.full:
+                            snet.flush_batch()
+                else:
+                    i = 0
+                    while i < px.shape[0]:
+                        i += snet.batcher.add_many(px[i:], py[i:])
+                        if snet.batcher.full:
+                            snet.flush_batch()
             snet.pipeline.merge_from([rnet.pipeline])
             # holdout windows interleave (keep-newest overflow), the same
             # merge the reference's rescale uses (CommonUtils.scala:36-48)

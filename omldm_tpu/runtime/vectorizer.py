@@ -63,6 +63,108 @@ class Vectorizer:
         return n + hash_dims
 
 
+@dataclasses.dataclass
+class SparseVectorizer:
+    """Maps DataInstances to padded-COO (idx[K], val[K]) records — the
+    TPU-native SparseVector (DataPointParser.scala:4,20-47): dense features
+    keep their positional slots [0, dense_dim), categorical features hash
+    into [dense_dim, dense_dim + hash_space) WITHOUT densifying. ``dim`` =
+    dense_dim + hash_space is the model width; ``max_nnz`` (K) is the fixed
+    per-record active-feature budget (pad slots idx=0/val=0 are inert in
+    the gather/scatter kernels, ops/sparse.py)."""
+
+    dim: int
+    hash_space: int
+    max_nnz: int
+
+    def vectorize(self, inst: DataInstance) -> Tuple[np.ndarray, np.ndarray]:
+        idx = np.zeros((self.max_nnz,), np.int32)
+        val = np.zeros((self.max_nnz,), np.float32)
+        k = 0
+        pos = 0
+        dense_budget = self.dim - self.hash_space
+        for feats in (inst.numerical_features, inst.discrete_features):
+            if feats:
+                for v in feats:
+                    if pos >= dense_budget or k >= self.max_nnz:
+                        break
+                    fv = float(v)
+                    if fv != 0.0:
+                        idx[k] = pos
+                        val[k] = fv
+                        k += 1
+                    pos += 1
+        if self.hash_space > 0 and inst.categorical_features:
+            base = self.dim - self.hash_space
+            for i, cat in enumerate(inst.categorical_features):
+                if k >= self.max_nnz:
+                    break
+                h = zlib.crc32(f"{i}={cat}".encode())
+                idx[k] = base + (h % self.hash_space)
+                # signed hashing keeps the estimate unbiased (same rule as
+                # the dense Vectorizer, so dense/sparse models agree)
+                val[k] = 1.0 if (h >> 1) % 2 == 0 else -1.0
+                k += 1
+        return idx, val
+
+
+class SparseMicroBatcher:
+    """Accumulates sparse records into fixed-shape ((idx, val), y, mask)
+    micro-batches — the padded-COO twin of MicroBatcher."""
+
+    def __init__(self, max_nnz: int, batch_size: int):
+        self.batch_size = batch_size
+        self.max_nnz = max_nnz
+        self._idx = np.zeros((batch_size, max_nnz), np.int32)
+        self._val = np.zeros((batch_size, max_nnz), np.float32)
+        self._y = np.zeros((batch_size,), np.float32)
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def full(self) -> bool:
+        return self._n >= self.batch_size
+
+    def add(self, idx: np.ndarray, val: np.ndarray, y: float) -> None:
+        self._idx[self._n] = idx
+        self._val[self._n] = val
+        self._y[self._n] = y
+        self._n += 1
+
+    def flush(self):
+        """((idx, val), y, mask) padded batch, or None if empty."""
+        if self._n == 0:
+            return None
+        mask = np.zeros((self.batch_size,), np.float32)
+        mask[: self._n] = 1.0
+        out = (
+            (self._idx.copy(), self._val.copy()),
+            self._y.copy(),
+            mask,
+        )
+        self._idx[:] = 0
+        self._val[:] = 0.0
+        self._y[:] = 0.0
+        self._n = 0
+        return out
+
+    def drain(self):
+        """UNPADDED pending rows ((idx, val), y) and reset; None if empty."""
+        if self._n == 0:
+            return None
+        out = (
+            (self._idx[: self._n].copy(), self._val[: self._n].copy()),
+            self._y[: self._n].copy(),
+        )
+        self._idx[:] = 0
+        self._val[:] = 0.0
+        self._y[:] = 0.0
+        self._n = 0
+        return out
+
+
 class MicroBatcher:
     """Accumulates vectorized records into fixed-shape (x, y, mask) batches.
 
