@@ -540,12 +540,43 @@ class SPMDTrainer:
         return int(syncs[0, 0])
 
     def bytes_shipped(self) -> int:
-        """Collective-bytes accounting reproducing the reference's
-        bytesShipped semantics (FlinkHub.scala:118-127): one sync moves every
-        worker's params up and the global back down."""
-        per_sync = 2 * self.flat_size * 4
-        mult = 1 if self.protocol in ("Asynchronous", "SSP") else self.dp
-        return self.sync_count() * per_sync * mult
+        """bytesShipped (FlinkHub.scala:118-127) from CALL-SITE counters,
+        not a closed-form guess: every collective site in the compiled step
+        increments a device-state counter when it executes, and each site's
+        per-execution payload is exact from its traced shapes:
+
+        - param sync (``_ps_allreduce`` under the protocol's condition):
+          counted per executing worker in ``syncs``; one execution moves
+          that worker's params up and the global back down
+          (2 * flat * 4B). For Sync/EASGD/GM/FGM the round counter covers
+          all dp workers; Async/SSP count per-worker folds directly.
+        - GM/FGM violation/safe-zone vote: a 1-scalar psum EVERY step per
+          worker (the protocol's cheap control channel) — 2 * 4B per
+          worker-step, read from the device ``step`` counter. This is the
+          traffic the communication-skipping protocols pay even in silent
+          rounds, previously uncounted.
+        """
+        syncs = np.asarray(jax.device_get(self.state["syncs"]))
+        param_bytes = 2 * self.flat_size * 4
+        if self.protocol in ("Asynchronous", "SSP"):
+            total = int(syncs[:, 0].sum()) * param_bytes
+        else:
+            total = int(syncs[0, 0]) * self.dp * param_bytes
+        if self.protocol in ("GM", "FGM"):
+            steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
+            total += steps * self.dp * 2 * 4
+        return total
+
+    def collective_bytes_physical(self) -> int:
+        """Bytes the HARDWARE moved, as opposed to the application-payload
+        accounting above: Async/SSP run their fold allreduce every step
+        (zero contributions still traverse ICI in lockstep SPMD), so their
+        physical traffic is per-step, not per-accepted-fold."""
+        steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
+        param_bytes = 2 * self.flat_size * 4
+        if self.protocol in ("Asynchronous", "SSP"):
+            return steps * self.dp * param_bytes
+        return self.bytes_shipped()
 
     def global_flat_params(self) -> np.ndarray:
         """Model of worker 0 / hub 0 (post-sync replicas agree)."""

@@ -29,6 +29,70 @@ from omldm_tpu.preprocessors.registry import make_preprocessor
 from omldm_tpu.utils import batch_valid_counts
 
 
+def _freeze(obj):
+    """Recursively hashable form of hyper-parameter structures."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
+
+
+# (learner spec, prep chain, dim, per_record) -> shared jitted callables.
+# Bounded in practice by the number of DISTINCT pipeline specs a job ever
+# deploys; entries capture ONLY stateless learner/preprocessor modules
+# (hyper-parameter holders), never a pipeline or its device-resident state
+# — a cached entry must not pin a deleted pipeline's weights.
+_JIT_CACHE: dict = {}
+
+
+def _build_impls(learner, preps, per_record):
+    """Pure step implementations closing over stateless modules only."""
+
+    def transform(prep_states, x):
+        for prep, s in zip(preps, prep_states):
+            x = prep.transform(s, x)
+        return x
+
+    def fit_impl(state, x, y, mask):
+        new_preps = []
+        z = x
+        for prep, s in zip(preps, state["preps"]):
+            s = prep.update(s, z, mask)
+            new_preps.append(s)
+            z = prep.transform(s, z)
+        update = learner.update_per_record if per_record else learner.update
+        params, loss = update(state["params"], z, y, mask)
+        n = jnp.sum(mask).astype(jnp.int32)
+        new_state = {
+            "preps": new_preps,
+            "params": params,
+            "fitted": state["fitted"] + n,
+            "cum_loss": state["cum_loss"] + loss * n.astype(jnp.float32),
+        }
+        return new_state, loss
+
+    def fit_many_impl(state, xs, ys, masks):
+        def step(st, batch):
+            x, y, m = batch
+            st, loss = fit_impl(st, x, y, m)
+            return st, loss
+
+        return jax.lax.scan(step, state, (xs, ys, masks))
+
+    def predict_impl(state, x):
+        return learner.predict(state["params"], transform(state["preps"], x))
+
+    def evaluate_impl(state, x, y, mask):
+        z = transform(state["preps"], x)
+        return (
+            learner.loss(state["params"], z, y, mask),
+            learner.score(state["params"], z, y, mask),
+        )
+
+    return fit_impl, predict_impl, evaluate_impl, fit_many_impl
+
+
 class MLPipeline:
     """One online-ML pipeline: a chain of preprocessors and a learner.
 
@@ -84,10 +148,38 @@ class MLPipeline:
             self._evaluate = self._evaluate_impl
             self._fit_many = None
         else:
-            self._fit = jax.jit(self._fit_impl, donate_argnums=0)
-            self._predict = jax.jit(self._predict_impl)
-            self._evaluate = jax.jit(self._evaluate_impl)
-            self._fit_many = jax.jit(self._fit_many_impl, donate_argnums=0)
+            # COMPILE SHARING across pipelines (SURVEY.md section 7 hard
+            # part (f)): K pipelines with the same (learner spec,
+            # preprocessor chain, dim, per_record) multiplex through ONE
+            # set of jitted step callables — the reference pays one
+            # BufferingWrapper per network but shares JVM-compiled code
+            # (SpokeLogic.scala:28-29); here the XLA analogue is sharing
+            # the traced programs, so the K-th identical Create costs zero
+            # recompiles. The impls are pure in `state` and close over
+            # THIS pipeline's stateless learner/prep modules only, so
+            # distinct pipelines' states flow through the same program and
+            # no deleted pipeline's device state stays pinned.
+            key = (
+                type(self.learner).__name__,
+                _freeze(self.learner.hp),
+                _freeze(self.learner.ds),
+                tuple((type(p).__name__, _freeze(p.hp)) for p in self.preps),
+                dim,
+                per_record,
+            )
+            cached = _JIT_CACHE.get(key)
+            if cached is None:
+                fit_i, pred_i, eval_i, many_i = _build_impls(
+                    self.learner, self.preps, per_record
+                )
+                cached = (
+                    jax.jit(fit_i, donate_argnums=0),
+                    jax.jit(pred_i),
+                    jax.jit(eval_i),
+                    jax.jit(many_i, donate_argnums=0),
+                )
+                _JIT_CACHE[key] = cached
+            self._fit, self._predict, self._evaluate, self._fit_many = cached
 
     # --- fused step implementations ---
 

@@ -38,11 +38,9 @@ class PipelineManager:
                     return f"unknown preprocessor {p.name!r}"
             if request.training_configuration.hub_parallelism < 1:
                 return "HubParallelism must be >= 1"
-            ds = request.learner.data_structure or {}
-            if ds.get("sparse") and "nFeatures" not in ds:
-                # the wide hashed index space cannot be inferred from the
-                # first record (SparseVectorizer needs the model width)
-                return "sparse learners require dataStructure.nFeatures"
+            err = self._validate_sparse(request)
+            if err:
+                return err
             return None
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
@@ -50,13 +48,34 @@ class PipelineManager:
             if request.request == RequestType.UPDATE:
                 if request.learner is None or not is_valid_learner(request.learner.name):
                     return "invalid update learner"
-                ds = request.learner.data_structure or {}
-                if ds.get("sparse") and "nFeatures" not in ds:
-                    # same rule as Create: a reused/inferred narrow dim
-                    # would make the hashed index space negative
-                    return "sparse learners require dataStructure.nFeatures"
+                err = self._validate_sparse(request)
+                if err:
+                    return err
             return None
         return f"unknown request type {request.request}"
+
+    @staticmethod
+    def _validate_sparse(request: Request) -> Optional[str]:
+        """Sparse requests must be fully deployable: a request that passes
+        the gate but raises at SpokeNet construction would kill the whole
+        job, not just itself (the reference silently drops invalid
+        requests, PipelineMap.scala:34,46)."""
+        ds = request.learner.data_structure or {}
+        if not ds.get("sparse"):
+            return None
+        if "nFeatures" not in ds:
+            # the wide hashed index space cannot be inferred from the
+            # first record (SparseVectorizer needs the model width)
+            return "sparse learners require dataStructure.nFeatures"
+        from omldm_tpu.learners.sparse_linear import SPARSE_LEARNERS
+
+        if request.learner.name not in SPARSE_LEARNERS:
+            return (
+                f"learner {request.learner.name!r} has no sparse variant"
+            )
+        if request.preprocessors:
+            return "sparse learners do not take preprocessors"
+        return None
 
     def admit(self, request: Request) -> bool:
         """Validate + update the live map; True if the request should be

@@ -276,6 +276,10 @@ class StreamJob:
         models lazily on first record)."""
         ds = request.learner.data_structure if request.learner else None
         if ds and "nFeatures" in ds:
+            if ds.get("sparse"):
+                # sparse widths are EXACT: hashSpace lives inside nFeatures
+                # and the dense hashDims knob does not apply to the COO path
+                return int(ds["nFeatures"])
             return int(ds["nFeatures"]) + int(
                 request.training_configuration.extra.get("hashDims", 0)
             )

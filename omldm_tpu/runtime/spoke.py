@@ -43,6 +43,61 @@ from omldm_tpu.runtime.vectorizer import (
 PREDICT_BATCH = 16
 
 
+class _PauseBuffer:
+    """Bounded ROW-accounted hold buffer for records arriving while a net is
+    paused (cooperative toggle). Beyond the cap the OLDEST rows drop —
+    the same keep-newest eviction as every other bounded buffer here
+    (SpokeLogic.scala:31-35); packed blocks are accounted and trimmed by
+    their row counts, not as single entries."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._entries: List[tuple] = []
+        self._rows = 0
+
+    def __len__(self) -> int:
+        return self._rows
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._entries
+
+    @staticmethod
+    def _entry_rows(entry) -> int:
+        if entry[0] == "__packed__":
+            return int(entry[1][0].shape[0])
+        return 1
+
+    def append(self, entry: tuple) -> None:
+        self._entries.append(entry)
+        self._rows += self._entry_rows(entry)
+        while self._entries and self._rows > self.cap:
+            excess = self._rows - self.cap
+            head = self._entries[0]
+            n = self._entry_rows(head)
+            if n <= excess:
+                self._entries.pop(0)
+                self._rows -= n
+            else:
+                px, py, pop = head[1]
+                self._entries[0] = (
+                    "__packed__",
+                    (px[excess:].copy(), py[excess:].copy(), pop[excess:].copy()),
+                    None, None,
+                )
+                self._rows -= excess
+
+    def drain(self) -> List[tuple]:
+        entries, self._entries = self._entries, []
+        self._rows = 0
+        return entries
+
+    def merge(self, others) -> None:
+        for other in others:
+            for entry in other.drain():
+                self.append(entry)
+
+
 class SpokeNet:
     """Per-(spoke, networkId) state: worker node + batcher + holdout set."""
 
@@ -90,6 +145,11 @@ class SpokeNet:
             config.test_set_size
         )
         self.holdout_count = 0
+        # records arriving while this net is PAUSED (cooperative toggle,
+        # FlinkSpoke.scala:127-131) buffer here and drain on resume — the
+        # reference's BufferingWrapper holds tuples the same way; beyond
+        # the row cap the oldest rows drop (keep-newest eviction)
+        self.pause_buffer = _PauseBuffer(config.record_buffer_cap)
 
     @property
     def pipeline(self) -> MLPipeline:
@@ -183,6 +243,13 @@ class Spoke:
 
     def _delete(self, network_id: int) -> None:
         self.nets.pop(network_id, None)
+        # a deleted net can no longer generate the hub RPCs that toggle its
+        # siblings: resume + drain any survivor left paused, or it would
+        # starve until the terminate probe
+        for net in self.nets.values():
+            if net.node.paused:
+                net.node.paused = False
+                self._drain_pause_buffer(net)
 
     def _make_send(self, network_id: int):
         def send(op: str, payload: Any, hub_id: int = 0) -> None:
@@ -197,9 +264,11 @@ class Spoke:
             self.record_buffer.append(inst)
             return
         for net in self.nets.values():
-            if net.node.paused:
-                continue
             x = net.vectorizer.vectorize(inst)
+            if net.node.paused:
+                # hold, don't drop: the net resumes on the next toggle
+                net.pause_buffer.append((inst.operation, x, inst.target, inst))
+                continue
             if inst.operation == FORECASTING:
                 self._serve(net, inst, x)
             else:
@@ -250,18 +319,10 @@ class Spoke:
         f_idx = np.nonzero(op != 0)[0]
         for net in self.nets.values():
             if net.node.paused:
+                # hold the whole block; drains via _drain_pause_buffer
+                net.pause_buffer.append(("__packed__", (x, y, op), None, None))
                 continue
-            # serve each forecast at its stream position: train the rows
-            # before it, predict, continue — matching per-record ordering
-            prev = 0
-            for f in f_idx:
-                f = int(f)
-                if f > prev:
-                    self._train_packed(net, x[prev:f], y[prev:f])
-                self._serve_packed(net, x, np.asarray([f]))
-                prev = f + 1
-            if prev < n:
-                self._train_packed(net, x[prev:], y[prev:])
+            self._process_packed_for_net(net, x, y, f_idx)
         nt = n - int(f_idx.size)
         if nt:
             pc = self._poll_counter
@@ -465,8 +526,12 @@ class Spoke:
     def handle_terminate_probe(self) -> None:
         """Termination probe: flush + evaluate every net, emit responseId -1
         fragments (FlinkSpoke.scala:136-138, FlinkLearning.scala:115-133) and
-        let worker nodes push final state."""
+        let worker nodes push final state. Paused nets resume and drain
+        first — quiesce releases cooperative pauses."""
         for net in self.nets.values():
+            if net.node.paused:
+                net.node.paused = False
+            self._drain_pause_buffer(net)
             net.flush_batch()
             net.node.on_flush()
             self.emit_query_response(net, TERMINATION_RESPONSE_ID)
@@ -475,8 +540,48 @@ class Spoke:
         self, network_id: int, hub_id: int, op: str, payload: Any
     ) -> None:
         net = self.nets.get(network_id)
-        if net is not None:
-            net.node.receive(op, payload, hub_id)
+        if net is None:
+            return
+        net.node.receive(op, payload, hub_id)
+        # cooperative multi-pipeline fairness: every hub RPC for one net
+        # TOGGLES the others (FlinkSpoke.scala:127-131) — alternating
+        # pause/resume yields the spoke between hosted pipelines; a net
+        # that just resumed drains the records buffered while paused
+        for other_id, other in self.nets.items():
+            if other_id == network_id:
+                continue
+            other.node.toggle()
+            if not other.node.paused:
+                self._drain_pause_buffer(other)
+
+    def _process_packed_for_net(self, net, x, y, f_idx) -> None:
+        """One net's share of a packed block: serve each forecast at its
+        stream position (train the rows before it first), matching
+        per-record ordering."""
+        n = x.shape[0]
+        prev = 0
+        for f in f_idx:
+            f = int(f)
+            if f > prev:
+                self._train_packed(net, x[prev:f], y[prev:f])
+            self._serve_packed(net, x, np.asarray([f]))
+            prev = f + 1
+        if prev < n:
+            self._train_packed(net, x[prev:], y[prev:])
+
+    def _drain_pause_buffer(self, net: SpokeNet) -> None:
+        if net.pause_buffer.is_empty:
+            return
+        for operation, x, target, inst in net.pause_buffer.drain():
+            if operation == "__packed__":
+                px, py, pop = x
+                self._process_packed_for_net(
+                    net, px, py, np.nonzero(pop != 0)[0]
+                )
+            elif operation == FORECASTING:
+                self._serve(net, inst, x)
+            else:
+                self._train(net, x, 0.0 if target is None else target)
 
     # --- live rescale (FlinkSpoke.scala:345-348, SpokeLogic.scala:37-50) ---
 
@@ -533,6 +638,8 @@ class Spoke:
             # merge the reference's rescale uses (CommonUtils.scala:36-48)
             snet.test_set.merge([rnet.test_set])
             snet.holdout_count += rnet.holdout_count
+            # records held under a cooperative pause carry over too
+            snet.pause_buffer.merge([rnet.pause_buffer])
         # pre-creation buffers carry over
         self.record_buffer.merge([retired.record_buffer])
         for block in retired._packed_buffer:

@@ -1,0 +1,130 @@
+"""The LIVE Kafka loop in ``__main__._run`` (reference: the unbounded
+Kafka-sourced job, Job.scala:42-87, with silence-timer termination,
+StatisticsOperator.scala:135-142) — driven end to end with fake clients:
+
+- records flow through the loop and train; the silence timer terminates the
+  job when the broker goes quiet;
+- sink precedence: an explicit ``--*Out`` file flag keeps priority over the
+  Kafka producer for that stream, while unflagged streams egress through
+  the producer;
+- the profile window is bounded: tracing stops after ``--profileSteps``
+  events while the job keeps running.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import omldm_tpu.runtime.kafka_io as kafka_io
+from omldm_tpu.__main__ import main
+from omldm_tpu.runtime.kafka_io import ProducerSinks, polling_events
+
+from tests.test_kafka_io import FakePollingConsumer, FakeProducer, FakeRecord
+
+
+def _records(n=500, dim=4, seed=0, forecasts=5):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    recs = [
+        FakeRecord(
+            "requests",
+            json.dumps({
+                "id": 0,
+                "request": "Create",
+                "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+                "trainingConfiguration": {"protocol": "CentralizedTraining"},
+            }).encode(),
+        )
+    ]
+    for _ in range(n):
+        x = rng.randn(dim)
+        recs.append(FakeRecord("trainingData", json.dumps({
+            "numericalFeatures": list(np.round(x, 4)),
+            "target": float(x @ w > 0),
+        }).encode()))
+    for _ in range(forecasts):
+        x = rng.randn(dim)
+        recs.append(FakeRecord("forecastingData", json.dumps({
+            "numericalFeatures": list(np.round(x, 4)),
+        }).encode()))
+    return recs
+
+
+def _fake_connect(monkeypatch, records):
+    producer = FakeProducer()
+
+    def connect(brokers, **kwargs):
+        consumer = FakePollingConsumer([records])
+        return polling_events(consumer), ProducerSinks(producer)
+
+    monkeypatch.setattr(kafka_io, "connect_kafka", connect)
+    return producer
+
+
+class TestKafkaLoop:
+    def test_trains_and_terminates_on_silence(self, tmp_path, monkeypatch):
+        producer = _fake_connect(monkeypatch, _records())
+        perf = tmp_path / "perf.jsonl"
+        rc = main([
+            "--kafkaBrokers", "fake:9092",
+            "--performanceOut", str(perf),
+            "--parallelism", "2",
+            "--timeout", "2500",
+        ])
+        assert rc == 0
+        stats = json.loads(perf.read_text())
+        [s] = stats["statistics"]
+        assert s["fitted"] > 300
+        assert s["score"] > 0.8
+
+    def test_sink_precedence_file_flag_beats_producer(self, tmp_path, monkeypatch):
+        producer = _fake_connect(monkeypatch, _records())
+        preds = tmp_path / "preds.jsonl"
+        rc = main([
+            "--kafkaBrokers", "fake:9092",
+            "--predictionsOut", str(preds),   # explicit file sink
+            "--parallelism", "1",
+            "--timeout", "2500",
+        ])
+        assert rc == 0
+        # predictions went to the FILE, not the producer
+        lines = [l for l in preds.read_text().splitlines() if l.strip()]
+        assert len(lines) == 5
+        pred_topics = [t for t, _ in producer.sent if t == "predictions"]
+        assert pred_topics == []
+        # performance (no file flag) egressed through the producer
+        perf_msgs = [v for t, v in producer.sent if t == "performance"]
+        assert len(perf_msgs) == 1
+        payload = json.loads(perf_msgs[0].decode())
+        assert payload["statistics"][0]["fitted"] > 300
+
+    def test_profile_window_bounded(self, tmp_path, monkeypatch):
+        import jax
+
+        producer = _fake_connect(monkeypatch, _records(n=120))
+        calls = {"start": 0, "stop": 0, "events_at_stop": None}
+        seen = {"n": 0}
+
+        def fake_start(path):
+            calls["start"] += 1
+
+        def fake_stop():
+            calls["stop"] += 1
+
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+        rc = main([
+            "--kafkaBrokers", "fake:9092",
+            "--performanceOut", str(tmp_path / "p.jsonl"),
+            "--profileDir", str(tmp_path / "trace"),
+            "--profileSteps", "10",
+            "--parallelism", "1",
+            "--timeout", "2500",
+        ])
+        assert rc == 0
+        assert calls["start"] == 1
+        assert calls["stop"] == 1  # stopped ONCE, at the window bound —
+        # not re-stopped in the finally block, and the job ran to
+        # termination afterwards (rc 0 with stats emitted)
+        assert (tmp_path / "p.jsonl").read_text().strip()

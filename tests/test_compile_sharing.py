@@ -1,0 +1,94 @@
+"""Compile sharing across multiplexed pipelines (SURVEY.md section 7 hard
+part (f)): K pipelines with identical (learner, preprocessors, dim,
+per_record) share ONE set of jitted step programs — the K-th identical
+Create costs zero recompiles (the reference hosts one wrapper per network
+over shared JVM code, SpokeLogic.scala:28-29)."""
+
+import json
+
+import numpy as np
+
+from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec
+from omldm_tpu.pipelines import MLPipeline
+
+
+def _spec():
+    return LearnerSpec("PA", hyper_parameters={"C": 1.0, "variant": "PA-I"})
+
+
+def test_ten_pipelines_share_jitted_steps_and_compile_once():
+    pipes = [
+        MLPipeline(_spec(), [PreprocessorSpec("StandardScaler")], dim=12)
+        for _ in range(10)
+    ]
+    # the mechanism: one shared jit callable object across all instances
+    for p in pipes[1:]:
+        assert p._fit is pipes[0]._fit
+        assert p._predict is pipes[0]._predict
+        assert p._fit_many is pipes[0]._fit_many
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 12).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    m = np.ones(32, np.float32)
+    for p in pipes:
+        p.fit(x, y, m)
+    # the compile counter: ONE traced/compiled entry serves all 10
+    assert pipes[0]._fit._cache_size() == 1
+
+
+def test_distinct_specs_do_not_share():
+    a = MLPipeline(_spec(), dim=12)
+    b = MLPipeline(
+        LearnerSpec("PA", hyper_parameters={"C": 2.0, "variant": "PA-I"}),
+        dim=12,
+    )
+    c = MLPipeline(_spec(), dim=16)
+    assert a._fit is not b._fit  # different hyper-parameters
+    assert a._fit is not c._fit  # different dim
+
+
+def test_shared_programs_keep_states_independent():
+    a = MLPipeline(_spec(), dim=8)
+    b = MLPipeline(_spec(), dim=8)
+    assert a._fit is b._fit
+    rng = np.random.RandomState(1)
+    xa = rng.randn(16, 8).astype(np.float32)
+    ya = (xa.sum(axis=1) > 0).astype(np.float32)
+    m = np.ones(16, np.float32)
+    a.fit(xa, ya, m)  # only a trains
+    fa, _ = a.get_flat_params()
+    fb, _ = b.get_flat_params()
+    assert np.abs(fa).sum() > 0
+    assert np.abs(fb).sum() == 0  # b untouched
+    assert a.fitted == 16 and b.fitted == 0
+
+
+def test_job_level_multiplexing_shares_compiles():
+    """10 identical Creates through the streaming runtime: every spoke-net
+    pipeline multiplexes through the same programs."""
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM, TRAINING_STREAM
+
+    job = StreamJob(JobConfig(parallelism=2, batch_size=32, test_set_size=16))
+    for i in range(10):
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": i, "request": "Create",
+            "learner": {"name": "SVM", "hyperParameters": {"lambda": 1e-3}},
+            "preProcessors": [],
+            "trainingConfiguration": {"protocol": "Asynchronous"},
+        }))
+    rng = np.random.RandomState(2)
+    for _ in range(300):
+        x = rng.randn(6)
+        job.process_event(TRAINING_STREAM, json.dumps({
+            "numericalFeatures": [round(float(v), 5) for v in x],
+            "target": float(x.sum() > 0),
+        }))
+    fits = {
+        net.pipeline._fit
+        for spoke in job.spokes
+        for net in spoke.nets.values()
+    }
+    assert len(fits) == 1  # 20 pipeline replicas, one traced program
+    assert next(iter(fits))._cache_size() <= 2
