@@ -143,9 +143,11 @@ class MLPipeline:
         self._fitted_host = 0
 
         if self.learner.host_side:
-            self._fit = self._fit_impl
-            self._predict = self._predict_impl
-            self._evaluate = self._evaluate_impl
+            # host-side learners (HT) run the SAME impls, un-jitted
+            fit_i, pred_i, eval_i, _ = _build_impls(
+                self.learner, self.preps, per_record
+            )
+            self._fit, self._predict, self._evaluate = fit_i, pred_i, eval_i
             self._fit_many = None
         else:
             # COMPILE SHARING across pipelines (SURVEY.md section 7 hard
@@ -180,57 +182,6 @@ class MLPipeline:
                 )
                 _JIT_CACHE[key] = cached
             self._fit, self._predict, self._evaluate, self._fit_many = cached
-
-    # --- fused step implementations ---
-
-    def _transform(self, prep_states, x):
-        for prep, s in zip(self.preps, prep_states):
-            x = prep.transform(s, x)
-        return x
-
-    def _fit_impl(self, state, x, y, mask):
-        new_preps = []
-        z = x
-        for prep, s in zip(self.preps, state["preps"]):
-            s = prep.update(s, z, mask)
-            new_preps.append(s)
-            z = prep.transform(s, z)
-        update = (
-            self.learner.update_per_record if self.per_record else self.learner.update
-        )
-        params, loss = update(state["params"], z, y, mask)
-        n = jnp.sum(mask).astype(jnp.int32)
-        new_state = {
-            "preps": new_preps,
-            "params": params,
-            "fitted": state["fitted"] + n,
-            "cum_loss": state["cum_loss"] + loss * n.astype(jnp.float32),
-        }
-        return new_state, loss
-
-    def _fit_many_impl(self, state, xs, ys, masks):
-        """T chained training steps in one XLA program (lax.scan over staged
-        micro-batches) — the device never waits on host dispatch between
-        steps. Replaces T per-batch JVM fit calls of the reference's hot
-        loop (MLPipeline.pipePoint, hs_err_pid77107.log:111) with one
-        program launch per T batches."""
-
-        def step(st, batch):
-            x, y, m = batch
-            st, loss = self._fit_impl(st, x, y, m)
-            return st, loss
-
-        return jax.lax.scan(step, state, (xs, ys, masks))
-
-    def _predict_impl(self, state, x):
-        return self.learner.predict(state["params"], self._transform(state["preps"], x))
-
-    def _evaluate_impl(self, state, x, y, mask):
-        z = self._transform(state["preps"], x)
-        return (
-            self.learner.loss(state["params"], z, y, mask),
-            self.learner.score(state["params"], z, y, mask),
-        )
 
     # --- public API ---
 

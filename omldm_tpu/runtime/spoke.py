@@ -87,6 +87,10 @@ class _PauseBuffer:
                 )
                 self._rows -= excess
 
+    def peek(self):
+        """Oldest held entry, or None."""
+        return self._entries[0] if self._entries else None
+
     def drain(self) -> List[tuple]:
         entries, self._entries = self._entries, []
         self._rows = 0
@@ -198,9 +202,8 @@ class Spoke:
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
-        # same total-row cap as the record buffer
-        self._packed_buffer: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-        self._packed_buffered_rows = 0
+        # same total-row keep-newest cap as the record buffer
+        self._packed_buffer = _PauseBuffer(config.record_buffer_cap)
         self._poll_counter = 0
 
     # --- control path (FlinkSpoke.processElement2) ---
@@ -235,11 +238,9 @@ class Spoke:
             self.record_buffer.clear()
             for inst in buffered:
                 self.handle_data(inst)
-        if self._packed_buffer:
-            blocks, self._packed_buffer = self._packed_buffer, []
-            self._packed_buffered_rows = 0
-            for x, y, op in blocks:
-                self.handle_packed(x, y, op)
+        if not self._packed_buffer.is_empty:
+            for _op, block, _t, _i in self._packed_buffer.drain():
+                self.handle_packed(*block)
 
     def _delete(self, network_id: int) -> None:
         self.nets.pop(network_id, None)
@@ -266,8 +267,14 @@ class Spoke:
         for net in self.nets.values():
             x = net.vectorizer.vectorize(inst)
             if net.node.paused:
-                # hold, don't drop: the net resumes on the next toggle
-                net.pause_buffer.append((inst.operation, x, inst.target, inst))
+                # hold, don't drop: the net resumes on the next toggle.
+                # Only forecasts need the original instance (for the
+                # prediction payload); training rows are fully captured by
+                # the vectorized x
+                held_inst = inst if inst.operation == FORECASTING else None
+                net.pause_buffer.append(
+                    (inst.operation, x, inst.target, held_inst)
+                )
                 continue
             if inst.operation == FORECASTING:
                 self._serve(net, inst, x)
@@ -299,22 +306,9 @@ class Spoke:
         if n == 0:
             return
         if not self.nets:
-            # same eviction direction as the per-record DataSet buffer:
-            # keep the NEWEST record_buffer_cap rows (SpokeLogic.scala:31-35)
-            self._packed_buffer.append((x, y, op))
-            self._packed_buffered_rows += n
-            cap = self.config.record_buffer_cap
-            while self._packed_buffered_rows > cap:
-                ox, oy, oop = self._packed_buffer[0]
-                excess = self._packed_buffered_rows - cap
-                if ox.shape[0] <= excess:
-                    self._packed_buffer.pop(0)
-                    self._packed_buffered_rows -= ox.shape[0]
-                else:
-                    self._packed_buffer[0] = (
-                        ox[excess:], oy[excess:], oop[excess:]
-                    )
-                    self._packed_buffered_rows -= excess
+            # same keep-newest eviction as the per-record DataSet buffer
+            # (SpokeLogic.scala:31-35), row-accounted by _PauseBuffer
+            self._packed_buffer.append(("__packed__", (x, y, op), None, None))
             return
         f_idx = np.nonzero(op != 0)[0]
         for net in self.nets.values():
@@ -334,8 +328,9 @@ class Spoke:
 
     def buffered_packed_dim(self) -> Optional[int]:
         """Feature width of buffered pre-creation packed rows, if any."""
-        if self._packed_buffer:
-            return int(self._packed_buffer[0][0].shape[1])
+        head = self._packed_buffer.peek()
+        if head is not None:
+            return int(head[1][0].shape[1])
         return None
 
     def _adapt_width(self, rows: np.ndarray, dim: int) -> np.ndarray:
@@ -638,13 +633,15 @@ class Spoke:
             # merge the reference's rescale uses (CommonUtils.scala:36-48)
             snet.test_set.merge([rnet.test_set])
             snet.holdout_count += rnet.holdout_count
-            # records held under a cooperative pause carry over too
+            # records held under a cooperative pause carry over too — and
+            # drain immediately if the survivor is running (nothing else
+            # may trigger a drain before the terminate probe)
             snet.pause_buffer.merge([rnet.pause_buffer])
+            if not snet.node.paused:
+                self._drain_pause_buffer(snet)
         # pre-creation buffers carry over
         self.record_buffer.merge([retired.record_buffer])
-        for block in retired._packed_buffer:
-            self._packed_buffer.append(block)
-            self._packed_buffered_rows += block[0].shape[0]
+        self._packed_buffer.merge([retired._packed_buffer])
         self._poll_counter += retired._poll_counter
 
     def mean_buffer_size(self) -> float:
