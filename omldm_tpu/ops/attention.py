@@ -150,8 +150,73 @@ def blockwise_attention(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  causal: bool, q_offset: int, kv_offset: int, lk: int,
+def _masked_scores(q_ref, k_ref, qi, ki, *, causal, q_offset, kv_offset, lk):
+    """Scaled QK^T for one (Q tile, K tile) pair with the K-padding and
+    causal masks applied — the ONE implementation all three kernels
+    (forward, dq, dk/dv) share so their masking can never diverge."""
+    block_q, dh = q_ref.shape
+    block_k = k_ref.shape[0]
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(float(dh))
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    ki_local = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    s = jnp.where(ki_local < lk, s, NEG_INF)
+    if causal:
+        q_pos = (
+            q_offset + qi * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        )
+        s = jnp.where(q_pos >= kv_offset + ki_local, s, NEG_INF)
+    return s, scale
+
+
+def _causal_block_needed(qi, ki, block_q, block_k, q_offset, kv_offset):
+    """A (Q tile, K tile) pair is skippable iff it lies entirely above the
+    causal diagonal."""
+    return (q_offset + qi * block_q + block_q - 1) >= (
+        kv_offset + ki * block_k
+    )
+
+
+def _vma_struct_factory(ref_array):
+    """ShapeDtypeStruct builder inheriting ``ref_array``'s varying-axis type
+    (required for pallas_call outputs under shard_map's vma checking)."""
+    try:
+        vma = jax.typeof(ref_array).vma
+    except Exception:
+        vma = None
+
+    def _struct(shape, dtype):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return _struct
+
+
+def _tpu_compiler_kwargs(interpret: bool) -> dict:
+    """dimension_semantics for the canonical (parallel, parallel, arbitrary)
+    flash grids, tolerant of the CompilerParams name moving across JAX
+    versions."""
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if interpret or params_cls is None:
+        return {}
+    return {
+        "compiler_params": params_cls(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    }
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, causal: bool, q_offset: int, kv_offset: int, lk: int,
                   n_k: int):
     """Grid: (B*H, Lq/block_q, Lk/block_k) with the K axis innermost
     (sequential). Each program sees ONE Q tile and ONE K/V tile; the
@@ -173,31 +238,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     if causal:
         # skip K blocks entirely above the causal diagonal: the last query
         # row of this Q tile attends to nothing in them
-        last_q_pos = q_offset + qi * block_q + (block_q - 1)
-        first_k_pos = kv_offset + ki * block_k
-        needed = last_q_pos >= first_k_pos
+        needed = _causal_block_needed(qi, ki, block_q, block_k,
+                                      q_offset, kv_offset)
     else:
         needed = ki >= 0  # always
 
     @pl.when(needed)
     def _block():
-        q = q_ref[...].astype(jnp.float32)  # [bq, dh]
-        k = k_ref[...].astype(jnp.float32)  # [bk, dh]
         v = v_ref[...].astype(jnp.float32)
-        scale = 1.0 / jnp.sqrt(float(dh))
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
-        ki_local = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(ki_local < lk, s, NEG_INF)
-        if causal:
-            q_pos = (
-                q_offset + qi * block_q
-                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            )
-            s = jnp.where(q_pos >= kv_offset + ki_local, s, NEG_INF)
+        s, _ = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
+                              q_offset=q_offset, kv_offset=kv_offset, lk=lk)
         m_prev = m_ref[...]  # [bq, 1]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -215,11 +265,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[...] = (
             acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
         ).astype(o_ref.dtype)
+        # per-row logsumexp: the backward kernels recompute P from S - lse
+        lse_ref[...] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "q_offset", "kv_offset", "interpret"),
+    static_argnames=("causal", "block_q", "block_k", "q_offset", "kv_offset",
+                     "interpret", "return_lse"),
 )
 def flash_attention_pallas(
     q: jnp.ndarray,
@@ -231,7 +284,8 @@ def flash_attention_pallas(
     q_offset: int = 0,
     kv_offset: int = 0,
     interpret: bool = False,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Pallas flash attention. q,k,v: [B, L, H, Dh] -> [B, Lq, H, Dh].
 
     The grid is (B*H, ceil(Lq/block_q), ceil(Lk/block_k)) with the K axis
@@ -263,10 +317,14 @@ def flash_attention_pallas(
         vma = jax.typeof(qf).vma
     except Exception:
         vma = None
+    def _struct(shape, dtype):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
     out_struct = (
-        jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype, vma=vma)
-        if vma
-        else jax.ShapeDtypeStruct((b * h, lq + pad_q, dh), q.dtype)
+        _struct((b * h, lq + pad_q, dh), q.dtype),
+        _struct((b * h, lq + pad_q, 1), jnp.float32),  # logsumexp rows
     )
     n_k = (lk + pad_k) // block_k
     grid = (b * h, (lq + pad_q) // block_q, n_k)
@@ -275,16 +333,9 @@ def flash_attention_pallas(
         pltpu.VMEM((block_q, 1), jnp.float32),    # m (running max)
         pltpu.VMEM((block_q, 1), jnp.float32),    # l (running denom)
     ]
-    kwargs = {}
-    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
-        pltpu, "TPUCompilerParams", None
-    )
-    if not interpret and params_cls is not None:
-        # the K axis carries the accumulators: sequential ("arbitrary");
-        # B*H and the Q tiles are embarrassingly parallel
-        kwargs["compiler_params"] = params_cls(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
+    # the K axis carries the accumulators: sequential ("arbitrary");
+    # B*H and the Q tiles are embarrassingly parallel
+    kwargs = _tpu_compiler_kwargs(interpret)
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel,
@@ -300,22 +351,121 @@ def flash_attention_pallas(
             pl.BlockSpec((None, block_k, dh), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((None, block_k, dh), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, dh), lambda i, j, kk: (i, j, 0)),
+        out_specs=(
+            pl.BlockSpec((None, block_q, dh), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j, kk: (i, j, 0)),
+        ),
         out_shape=out_struct,
         scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
     )(qf, kf, vf)
+    out, lse = out
     out = out[:, :lq].reshape(b, h, lq, dh).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse  # lse stays in the flattened [B*H, Lq+pad, 1] layout
     return out
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, causal, q_offset, kv_offset,
+                         lk, n_k):
+    """dQ pass: grid (B*H, Lq/bq, Lk/bk), K sequential. Recomputes each
+    score block from the saved per-row logsumexp (flash backward never
+    materializes P) and accumulates dQ in VMEM scratch."""
+    block_q, dh = q_ref.shape
+    block_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        needed = _causal_block_needed(qi, ki, block_q, block_k,
+                                      q_offset, kv_offset)
+    else:
+        needed = ki >= 0
+
+    @pl.when(needed)
+    def _block():
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        s, scale = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
+                                  q_offset=q_offset, kv_offset=kv_offset,
+                                  lk=lk)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[...]))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[...])
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                           q_offset, kv_offset, lk, n_q):
+    """dK/dV pass: grid (B*H, Lk/bk, Lq/bq), Q sequential. One K/V tile's
+    gradients accumulate across the whole Q sweep in VMEM scratch."""
+    block_q, dh = q_ref.shape
+    block_k = k_ref.shape[0]
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        needed = _causal_block_needed(qi, ki, block_q, block_k,
+                                      q_offset, kv_offset)
+    else:
+        needed = qi >= 0
+
+    @pl.when(needed)
+    def _block():
+        q = q_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        s, scale = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
+                                  q_offset=q_offset, kv_offset=kv_offset,
+                                  lk=lk)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[...]))
+        # dV += P^T dO
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[...])
+        # dK += dS^T Q * scale
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_diff(q, k, v, causal: bool, q_offset: int, kv_offset: int,
                 interpret: bool = False):
-    """Differentiable wrapper: Pallas forward, blockwise-derived backward
-    (flash backward recomputes attention anyway; the blockwise VJP is the
-    same O(L * block) memory)."""
+    """Differentiable Pallas flash attention: Pallas forward AND backward
+    (dq / dk-dv passes recompute scores from the saved logsumexp)."""
     return flash_attention_pallas(
         q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset,
         interpret=interpret,
@@ -323,22 +473,87 @@ def _flash_diff(q, k, v, causal: bool, q_offset: int, kv_offset: int,
 
 
 def _flash_diff_fwd(q, k, v, causal, q_offset, kv_offset, interpret=False):
-    out = flash_attention_pallas(
+    out, lse = flash_attention_pallas(
         q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset,
-        interpret=interpret,
+        interpret=interpret, return_lse=True,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset
+    q, k, v, out, lse = res
+    b, lq, h, dh = q.shape
+    lk = k.shape[1]
+    block_q = min(512, lq)
+    block_k = min(512, lk)
+    pad_q = (-lq) % block_q
+    pad_k = (-lk) % block_k
+    n_q = (lq + pad_q) // block_q
+    n_k = (lk + pad_k) // block_k
+
+    def flat(a, pad):
+        f = a.transpose(0, 2, 1, 3).reshape(b * h, a.shape[1], dh)
+        if pad:
+            f = jnp.pad(f, ((0, 0), (0, pad), (0, 0)))
+        return f
+
+    qf, kf, vf = flat(q, pad_q), flat(k, pad_k), flat(v, pad_k)
+    dof, of = flat(g, pad_q), flat(out, pad_q)
+    # delta_i = rowsum(dO * O) per query row — tiny elementwise op, fused
+    # by XLA around the kernels
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, lq+pad, 1]
+
+    # under shard_map's vma typing the kernel outputs must declare which
+    # mesh axes they vary over — inherit the cotangent's (same as forward)
+    _struct = _vma_struct_factory(dof)
+    kwargs = _tpu_compiler_kwargs(interpret)
+    q_spec = pl.BlockSpec((None, block_q, dh), lambda i, a, b_: (i, a, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda i, a, b_: (i, a, 0))
+    kv_spec = pl.BlockSpec((None, block_k, dh), lambda i, a, b_: (i, b_, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, q_offset=q_offset,
+            kv_offset=kv_offset, lk=lk, n_k=n_k,
         ),
-        q, k, v,
-    )
-    return vjp(g)
+        grid=(b * h, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_struct((b * h, lq + pad_q, dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dK/dV pass: grid axes swap roles — a/b_ are (k tile, q tile)
+    q_spec2 = pl.BlockSpec((None, block_q, dh), lambda i, a, b_: (i, b_, 0))
+    row_spec2 = pl.BlockSpec((None, block_q, 1), lambda i, a, b_: (i, b_, 0))
+    kv_spec2 = pl.BlockSpec((None, block_k, dh), lambda i, a, b_: (i, a, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, causal=causal, q_offset=q_offset,
+            kv_offset=kv_offset, lk=lk, n_q=n_q,
+        ),
+        grid=(b * h, n_k, n_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(
+            _struct((b * h, lk + pad_k, dh), k.dtype),
+            _struct((b * h, lk + pad_k, dh), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dh), jnp.float32),
+            pltpu.VMEM((block_k, dh), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(a, l):
+        return a[:, :l].reshape(b, h, l, dh).transpose(0, 2, 1, 3)
+
+    return unflat(dq, lq), unflat(dk, lk), unflat(dv, lk)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -355,7 +570,9 @@ def attention(
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Backend-dispatching attention entry point: the Pallas kernel on TPU
-    (differentiable via a blockwise-derived VJP), blockwise scan elsewhere."""
+    (differentiable end to end — Pallas forward AND the dq / dk-dv backward
+    kernels recomputing P from the saved logsumexp), blockwise scan
+    elsewhere."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
