@@ -144,6 +144,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 def _run(job: StreamJob, flags: Dict[str, str]) -> int:
     if "kafkaBrokers" in flags:
+        if int(flags.get("restartAttempts", "0")) > 0:
+            # supervised recovery needs a REPLAYABLE source; a live Kafka
+            # consumer is not rewindable here, so say so instead of letting
+            # the flag silently do nothing
+            print(
+                "warning: --restartAttempts applies only to replayable "
+                "file sources; ignored with --kafkaBrokers",
+                file=sys.stderr,
+            )
         from omldm_tpu.runtime.kafka_io import connect_kafka
 
         events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
@@ -197,30 +206,53 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
 
                 jax.profiler.stop_trace()
     elif "events" in flags:
-        job.run(combined_events(flags["events"]))
+        _run_replay(job, flags, lambda: combined_events(flags["events"]))
     else:
-        packed = None
-        if (
-            TRAINING_STREAM in flags
-            and flags.get("fastIngest", "auto") != "false"
-        ):
-            packed = _packed_training_source(flags)
-        sources = []
-        for topic in _STREAMS:
-            if topic not in flags:
-                continue
-            if topic == TRAINING_STREAM and packed is not None:
-                sources.append(packed)
-            else:
-                sources.append(file_events(flags[topic], topic))
-        if not sources:
-            raise SystemExit(
-                "no sources: pass --trainingData/--forecastingData/"
-                "--requests <path.jsonl>, --events <combined.jsonl>, "
-                "or --kafkaBrokers <host:port>"
-            )
-        job.run(interleave(*sources))
+
+        def make_events():
+            packed = None
+            if (
+                TRAINING_STREAM in flags
+                and flags.get("fastIngest", "auto") != "false"
+            ):
+                packed = _packed_training_source(flags)
+            sources = []
+            for topic in _STREAMS:
+                if topic not in flags:
+                    continue
+                if topic == TRAINING_STREAM and packed is not None:
+                    sources.append(packed)
+                else:
+                    sources.append(file_events(flags[topic], topic))
+            if not sources:
+                raise SystemExit(
+                    "no sources: pass --trainingData/--forecastingData/"
+                    "--requests <path.jsonl>, --events <combined.jsonl>, "
+                    "or --kafkaBrokers <host:port>"
+                )
+            return interleave(*sources)
+
+        _run_replay(job, flags, make_events)
     return 0
+
+
+def _run_replay(job: StreamJob, flags: Dict[str, str], make_events) -> None:
+    """Replay a deterministic source; ``--restartAttempts N`` opts into
+    supervised recovery (Flink's fixed-delay restart strategy: restore the
+    latest checkpoint — pass ``--checkpointing`` for stateful recovery —
+    and resume the replay at the snapshot's event offset)."""
+    attempts = int(flags.get("restartAttempts", "0"))
+    if attempts > 0:
+        from omldm_tpu.runtime.recovery import JobSupervisor, replayable
+
+        JobSupervisor(
+            job,
+            replayable(make_events),
+            max_restarts=attempts,
+            restart_delay_s=float(flags.get("restartDelayMs", "0")) / 1000.0,
+        ).run()
+    else:
+        job.run(make_events())
 
 
 def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:

@@ -27,6 +27,7 @@ internal state, not an interchange format.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import os
 import pickle
@@ -59,6 +60,29 @@ def _to_host(tree):
     )
 
 
+# node attributes that are wiring (callables/config) or restored separately
+# (the pipeline), not protocol state
+_NODE_SKIP = frozenset({"pipeline", "config", "send", "reply", "broadcast"})
+
+
+def _node_state(node) -> dict:
+    """Snapshot a protocol node's round state (sync barriers, clocks,
+    partial rounds, blocked-batch buffers, statistics counters) — the state
+    the reference keeps in its wrapper/PS objects inside Flink operator
+    state (FlinkSpoke.scala:233-251). Wiring attributes are excluded and
+    re-established by the runtime on restore."""
+    return {
+        k: copy.deepcopy(v)
+        for k, v in vars(node).items()
+        if k not in _NODE_SKIP and not callable(v)
+    }
+
+
+def _restore_node(node, state: Optional[dict]) -> None:
+    if state:
+        vars(node).update(copy.deepcopy(state))
+
+
 class CheckpointManager:
     def __init__(self, directory: str):
         self.directory = directory
@@ -82,13 +106,47 @@ class CheckpointManager:
                     "holdout_count": net.holdout_count,
                     "test_set": net.test_set.to_list(),
                     "pending": self._batcher_contents(net.batcher),
+                    "node": _node_state(net.node),
                 }
             spokes.append(nets)
+        hub_nodes = {}
+        for (net_id, hub_id), hub in job.hub_manager.hubs.items():
+            entry: Dict[str, Any] = {"node": _node_state(hub.node)}
+            central = getattr(hub.node, "pipeline", None)
+            if central is not None:
+                # SingleLearner: THE model lives on the hub (FlinkHub.scala:
+                # 128-153) — snapshot it like a spoke pipeline
+                entry["pipeline"] = {
+                    "params": _to_host(central.state["params"]),
+                    "preps": [_to_host(s) for s in central.state["preps"]],
+                    "fitted": central.fitted,
+                    "cum_loss": central.cumulative_loss,
+                }
+            hub_nodes[(net_id, hub_id)] = entry
         hub_stats = {}
         for net_id in job.pipeline_manager.live_pipelines:
             merged = job.hub_manager.network_statistics(net_id)
             if merged is not None:
                 hub_stats[net_id] = merged.to_dict()
+        bridges = {}
+        for net_id, bridge in job.spmd_bridges.items():
+            t = bridge.trainer
+            test_x, test_y = bridge.test_set.arrays()
+            bridges[net_id] = {
+                "mesh": (t.dp, t.hub),
+                "fleet": _to_host(t.state),
+                "fitted": t.fitted,
+                "steps": t._steps_host,
+                "holdout_count": bridge.holdout_count,
+                "test_x": test_x.copy(),
+                "test_y": test_y.copy(),
+                "stage_x": np.asarray(
+                    bridge._stage_x[: bridge._stage_n], np.float32
+                ).copy(),
+                "stage_y": np.asarray(
+                    bridge._stage_y[: bridge._stage_n], np.float32
+                ).copy(),
+            }
         snapshot = {
             "config": dataclasses.asdict(job.config),
             "requests": [
@@ -97,6 +155,17 @@ class CheckpointManager:
             "dims": dict(job._dims),
             "spokes": spokes,
             "hub_stats": hub_stats,
+            "hub_nodes": hub_nodes,
+            "bridges": bridges,
+            # stream position + routing state: a supervisor resumes a
+            # replayable source at ``offset`` and the restored job routes
+            # subsequent records exactly as the original would have (the
+            # role of source offsets in a Flink checkpoint barrier)
+            "offset": job.events_processed,
+            "rr": job._rr,
+            "backlog": list(job._backlog),
+            "backlog_rows": job._backlog_rows,
+            "pending_creates": [r.to_dict() for r in job._pending_creates],
             "time": time.time(),
         }
         path = os.path.join(self.directory, f"ckpt_{int(time.time()*1000)}.pkl")
@@ -159,6 +228,20 @@ class CheckpointManager:
         for net_id_key in {k for nets in snapshot["spokes"] for k in nets}:
             self._restore_network(job, snapshot, net_id_key)
 
+        for net_id, bd in snapshot.get("bridges", {}).items():
+            self._restore_bridge(job, int(net_id), bd)
+
+        # stream position + routing continuity (resume-from-offset replay)
+        job.events_processed = snapshot.get("offset", 0)
+        job._rr = snapshot.get("rr", 0)
+        import collections as _collections
+
+        job._backlog = _collections.deque(snapshot.get("backlog", ()))
+        job._backlog_rows = snapshot.get("backlog_rows", len(job._backlog))
+        job._pending_creates = [
+            Request.from_dict(d) for d in snapshot.get("pending_creates", ())
+        ]
+
         # protocol statistics continuity (counters keep accumulating)
         for net_id, sd in snapshot["hub_stats"].items():
             hub = job.hub_manager.hubs.get((int(net_id), 0))
@@ -170,7 +253,89 @@ class CheckpointManager:
                 s.fitted = sd["fitted"]
                 s.learning_curve = list(sd["learningCurve"])
                 s.lcx = list(sd["LCX"])
+
+        # protocol ROUND state (sync barriers, partial rounds, clocks,
+        # blocked batches, per-worker watermarks): exact continuity is only
+        # well-defined 1:1 — under a rescale the fresh nodes start a clean
+        # round over the merged model instead
+        same_parallelism = len(snapshot["spokes"]) == len(job.spokes)
+        if same_parallelism:
+            for spoke, nets in zip(job.spokes, snapshot["spokes"]):
+                for net_id, sv in nets.items():
+                    net = spoke.nets.get(net_id)
+                    if net is not None:
+                        _restore_node(net.node, sv.get("node"))
+        for key, entry in snapshot.get("hub_nodes", {}).items():
+            hub = job.hub_manager.hubs.get(key)
+            if hub is None:
+                continue
+            if same_parallelism:
+                _restore_node(hub.node, entry.get("node"))
+            # the SingleLearner central model does NOT depend on the spoke
+            # count — THE model lives on the hub and must survive a rescale
+            # restore too (only round state resets across a rescale)
+            central = getattr(hub.node, "pipeline", None)
+            if central is not None and "pipeline" in entry:
+                pv = entry["pipeline"]
+                central.state["params"] = pv["params"]
+                central.state["preps"] = list(pv["preps"])
+                central.state["cum_loss"] = jnp.asarray(
+                    pv["cum_loss"], jnp.float32
+                )
+                central._fitted_host = pv["fitted"]
         return job
+
+    def _restore_bridge(self, job, net_id: int, bd: dict) -> None:
+        """Restore an SPMD-engine pipeline: fleet state back onto the mesh.
+
+        Same mesh shape: exact shard-by-shard re-placement. Different shape
+        (restore under a different parallelism/device count): every worker
+        replica seeds from the saved worker-0 model — post-sync replicas
+        agree, so worker 0 IS the fleet model — with progress counters
+        carried and staleness clocks restarted coherently at zero."""
+        bridge = job.spmd_bridges.get(net_id)
+        if bridge is None:
+            return
+        from omldm_tpu.parallel.ckpt import place_tree
+
+        t = bridge.trainer
+        fleet = bd["fleet"]
+        if (t.dp, t.hub) == tuple(bd["mesh"]):
+            t.state = place_tree(fleet, t._state_specs, t.mesh)
+        else:
+
+            def tile(leaf):
+                l = np.asarray(leaf)[0, 0]
+                return np.broadcast_to(
+                    l, (t.dp, t.hub) + l.shape
+                ).copy()
+
+            new_state = {
+                "params": jax.tree_util.tree_map(tile, fleet["params"]),
+                "preps": [
+                    jax.tree_util.tree_map(tile, p) for p in fleet["preps"]
+                ],
+                "est": tile(fleet["est"]),
+                "center": tile(fleet["center"]),
+                "step": tile(fleet["step"]),
+                "syncs": tile(fleet["syncs"]),
+                "cum_loss": tile(fleet["cum_loss"]),
+                "clock": np.zeros_like(tile(fleet["clock"])),
+                "accepted": np.ones_like(tile(fleet["accepted"])),
+            }
+            # call-site byte counters and any protocol-specific extras
+            # carry over worker-0's values so accounting stays monotonic
+            for key, val in fleet.items():
+                if key not in new_state:
+                    new_state[key] = tile(val)
+            t.state = place_tree(new_state, t._state_specs, t.mesh)
+        t._fitted_host = bd["fitted"]
+        t._steps_host = bd["steps"]
+        bridge.holdout_count = bd["holdout_count"]
+        if bd["test_x"].shape[0]:
+            bridge.test_set.append_many(bd["test_x"], bd["test_y"])
+        if bd["stage_x"].shape[0]:
+            bridge._stage_rows(bd["stage_x"], bd["stage_y"])
 
     def _restore_network(self, job, snapshot, net_id: int):
         saved = [

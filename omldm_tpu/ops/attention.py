@@ -313,15 +313,7 @@ def flash_attention_pallas(
 
     # under shard_map's vma typing the kernel output must declare which mesh
     # axes it varies over — inherit the query's
-    try:
-        vma = jax.typeof(qf).vma
-    except Exception:
-        vma = None
-    def _struct(shape, dtype):
-        if vma:
-            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-        return jax.ShapeDtypeStruct(shape, dtype)
-
+    _struct = _vma_struct_factory(qf)
     out_struct = (
         _struct((b * h, lq + pad_q, dh), q.dtype),
         _struct((b * h, lq + pad_q, 1), jnp.float32),  # logsumexp rows

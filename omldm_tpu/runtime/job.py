@@ -89,6 +89,11 @@ class StreamJob:
         # rows flow) and would train only on the host plane's spoke buffers.
         self._backlog: Deque[tuple] = collections.deque()
         self._backlog_rows = 0
+        # stream position: events consumed so far. Checkpoints record it so
+        # a supervisor can resume a replayable source from the exact event
+        # the snapshot covers (the role of Flink's source offsets in a
+        # checkpoint barrier; runtime.recovery.JobSupervisor)
+        self.events_processed = 0
         # pipelines deployed on the SPMD collective engine instead of the
         # host plane (trainingConfiguration {"engine": "spmd"})
         self.spmd_bridges: Dict[int, Any] = {}
@@ -151,6 +156,7 @@ class StreamJob:
     def process_event(self, stream: str, payload: Any) -> None:
         if self.stats.terminated:
             return
+        self.events_processed += 1
         if stream == REQUEST_STREAM:
             request = (
                 payload if isinstance(payload, Request) else Request.from_json(payload)

@@ -1,0 +1,198 @@
+"""Failure detection and restart-from-checkpoint supervision.
+
+Reference counterpart: the reference job carries no failure detector of its
+own — it delegates crash recovery to Flink's restart-from-checkpoint
+machinery (the ``RestartStrategies`` import at Job.scala:14 and the opt-in
+checkpoint config, Checkpointing.scala:9-25; SURVEY.md §5 "failure
+detection"). This module is that machinery, framework-native:
+
+- :class:`JobSupervisor` runs a :class:`~omldm_tpu.runtime.job.StreamJob`
+  over a REPLAYABLE event source, detects failures (any exception escaping
+  event processing), and restarts the job from its latest checkpoint,
+  resuming the source at the exact event offset the snapshot covers —
+  Flink's fixed-delay restart strategy (attempts + delay).
+- Without checkpointing, a restart is from scratch at offset 0 — Flink's
+  behavior for an uncheckpointed job.
+- :class:`FaultInjector` arms deterministic crashes inside spokes for
+  recovery tests and drills (the fault-injection half of SURVEY §5 row
+  "failure detection / elastic recovery / fault injection").
+
+Consistency model: checkpoints are taken synchronously BETWEEN events
+(StreamJob.run calls ``maybe_save`` after each ``process_event``), so a
+restored job's state corresponds exactly to the recorded offset — replaying
+the remaining events yields exactly-once *state* updates. Output sinks are
+not transactional: predictions/responses emitted between the last checkpoint
+and the crash are emitted again on replay (at-least-once sinks, as in Flink
+without two-phase-commit sinks). A deterministic poison event crashes every
+attempt and exhausts ``max_restarts`` — also Flink semantics.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+from omldm_tpu.api.stats import JobStatistics
+from omldm_tpu.runtime.job import StreamJob
+
+Event = Tuple[str, Any]
+# a replayable source: offset -> the remaining events from that position
+SourceFactory = Callable[[int], Iterable[Event]]
+
+
+class InjectedFault(RuntimeError):
+    """Raised by :class:`FaultInjector` trip-wires."""
+
+
+@dataclasses.dataclass
+class FailureRecord:
+    """One detected job failure (the supervisor's incident log)."""
+
+    offset: int  # events consumed when the failure surfaced
+    error: str
+    at: float
+    restored_from: Optional[str] = None  # checkpoint path, None = fresh
+
+
+def skip_events(events: Iterable[Event], n: int) -> Iterator[Event]:
+    """Drop the first ``n`` events of a replay — turns a from-the-start
+    source into a from-offset source for deterministic files/iterables."""
+    it = iter(events)
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            return
+    yield from it
+
+
+def replayable(make_events: Callable[[], Iterable[Event]]) -> SourceFactory:
+    """Lift a zero-argument source constructor (e.g. re-opening the same
+    files) into a :data:`SourceFactory` by skipping already-consumed
+    events. Valid for deterministic sources: the same constructor must
+    yield the same event sequence on every call."""
+
+    def factory(offset: int) -> Iterable[Event]:
+        return skip_events(make_events(), offset)
+
+    return factory
+
+
+class JobSupervisor:
+    """Run a job to completion, restarting on failure.
+
+    ``job`` should have checkpointing enabled (``config.checkpointing``)
+    for restore-from-snapshot recovery; otherwise every restart replays
+    from the beginning with fresh state. Sinks installed on the supervised
+    job are carried onto each restarted incarnation.
+    """
+
+    def __init__(
+        self,
+        job: StreamJob,
+        source_factory: SourceFactory,
+        max_restarts: int = 3,
+        restart_delay_s: float = 0.0,
+        on_failure: Optional[Callable[[FailureRecord], None]] = None,
+    ):
+        self.job = job
+        self.source_factory = source_factory
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.on_failure = on_failure
+        self.failures: List[FailureRecord] = []
+        # only checkpoints taken DURING this supervised run are restore
+        # candidates: a stale snapshot left in a reused checkpoint directory
+        # by an earlier job would otherwise be restored silently — its
+        # near-end offset skipping (and masking) almost the whole stream
+        manager = job.checkpoint_manager
+        self._ckpt_floor = (
+            manager.latest_path() if manager is not None else None
+        )
+
+    def run(self, terminate_on_end: bool = True) -> Optional[JobStatistics]:
+        while True:
+            job = self.job
+            try:
+                return job.run(
+                    self.source_factory(job.events_processed),
+                    terminate_on_end=terminate_on_end,
+                )
+            except Exception as exc:  # any escape is a detected job failure
+                record = FailureRecord(
+                    offset=job.events_processed,
+                    error=f"{type(exc).__name__}: {exc}",
+                    at=time.time(),
+                )
+                self.failures.append(record)
+                if len(self.failures) > self.max_restarts:
+                    raise
+                if self.restart_delay_s > 0:
+                    time.sleep(self.restart_delay_s)
+                self.job = self._recover(job, record)
+                if self.on_failure is not None:
+                    self.on_failure(record)
+
+    def _recover(self, failed: StreamJob, record: FailureRecord) -> StreamJob:
+        """Build the next incarnation: restore the latest checkpoint when
+        one exists, else a fresh job from the original config (offset 0)."""
+        manager = failed.checkpoint_manager
+        path = manager.latest_path() if manager is not None else None
+        if path == self._ckpt_floor:
+            path = None  # pre-existing snapshot from an earlier run
+        if path is not None:
+            job = manager.restore(path=path)
+            record.restored_from = path
+        else:
+            job = StreamJob(copy.deepcopy(failed.config))
+        job.set_sinks(
+            on_prediction=failed._on_prediction,
+            on_response=failed._on_response,
+            on_performance=failed._on_performance,
+        )
+        return job
+
+
+class FaultInjector:
+    """Deterministic crash injection for recovery tests and drills.
+
+    ``arm(job, worker_id, after_records)`` trips an :class:`InjectedFault`
+    out of the target spoke once it has handled ``after_records`` more
+    records (per-record and packed rows both count). One-shot by default —
+    the fault models a transient crash: after firing once it never fires
+    again, including on job incarnations built by recovery."""
+
+    def __init__(self, one_shot: bool = True):
+        self.one_shot = one_shot
+        self.fired = 0
+        self._armed = True
+
+    def arm(self, job: StreamJob, worker_id: int, after_records: int) -> None:
+        spoke = job.spokes[worker_id]
+        remaining = [after_records]
+        orig_data, orig_packed = spoke.handle_data, spoke.handle_packed
+
+        def _trip(rows: int) -> None:
+            if not self._armed:
+                return
+            remaining[0] -= rows
+            if remaining[0] <= 0:
+                self.fired += 1
+                if self.one_shot:
+                    self._armed = False
+                raise InjectedFault(
+                    f"injected crash in worker {worker_id}"
+                )
+
+        def handle_data(inst):
+            _trip(1)
+            return orig_data(inst)
+
+        def handle_packed(x, y, op):
+            _trip(int(x.shape[0]))
+            return orig_packed(x, y, op)
+
+        spoke.handle_data = handle_data
+        spoke.handle_packed = handle_packed
