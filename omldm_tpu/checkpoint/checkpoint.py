@@ -83,6 +83,24 @@ def _restore_node(node, state: Optional[dict]) -> None:
         vars(node).update(copy.deepcopy(state))
 
 
+def _pipeline_snapshot(pipe) -> dict:
+    """The one pipeline-state schema: spoke nets and the SingleLearner hub
+    model both save/load through this pair so the field set cannot drift."""
+    return {
+        "params": _to_host(pipe.state["params"]),
+        "preps": [_to_host(s) for s in pipe.state["preps"]],
+        "fitted": pipe.fitted,
+        "cum_loss": pipe.cumulative_loss,
+    }
+
+
+def _pipeline_load(pipe, sv: dict) -> None:
+    pipe.state["params"] = sv["params"]
+    pipe.state["preps"] = list(sv["preps"])
+    pipe.state["cum_loss"] = jnp.asarray(sv["cum_loss"], jnp.float32)
+    pipe._fitted_host = sv["fitted"]
+
+
 class CheckpointManager:
     def __init__(self, directory: str):
         self.directory = directory
@@ -99,10 +117,7 @@ class CheckpointManager:
             for net_id, net in spoke.nets.items():
                 pipe = net.pipeline
                 nets[net_id] = {
-                    "params": _to_host(pipe.state["params"]),
-                    "preps": [_to_host(s) for s in pipe.state["preps"]],
-                    "fitted": pipe.fitted,
-                    "cum_loss": pipe.cumulative_loss,
+                    **_pipeline_snapshot(pipe),
                     "holdout_count": net.holdout_count,
                     "test_set": net.test_set.to_list(),
                     "pending": self._batcher_contents(net.batcher),
@@ -116,12 +131,7 @@ class CheckpointManager:
             if central is not None:
                 # SingleLearner: THE model lives on the hub (FlinkHub.scala:
                 # 128-153) — snapshot it like a spoke pipeline
-                entry["pipeline"] = {
-                    "params": _to_host(central.state["params"]),
-                    "preps": [_to_host(s) for s in central.state["preps"]],
-                    "fitted": central.fitted,
-                    "cum_loss": central.cumulative_loss,
-                }
+                entry["pipeline"] = _pipeline_snapshot(central)
             hub_nodes[(net_id, hub_id)] = entry
         hub_stats = {}
         for net_id in job.pipeline_manager.live_pipelines:
@@ -276,13 +286,7 @@ class CheckpointManager:
             # restore too (only round state resets across a rescale)
             central = getattr(hub.node, "pipeline", None)
             if central is not None and "pipeline" in entry:
-                pv = entry["pipeline"]
-                central.state["params"] = pv["params"]
-                central.state["preps"] = list(pv["preps"])
-                central.state["cum_loss"] = jnp.asarray(
-                    pv["cum_loss"], jnp.float32
-                )
-                central._fitted_host = pv["fitted"]
+                _pipeline_load(central, entry["pipeline"])
         return job
 
     def _restore_bridge(self, job, net_id: int, bd: dict) -> None:
@@ -402,11 +406,7 @@ class CheckpointManager:
 
     @staticmethod
     def _load_net_state(net, sv: dict) -> None:
-        pipe = net.pipeline
-        pipe.state["params"] = sv["params"]
-        pipe.state["preps"] = list(sv["preps"])
-        pipe.state["cum_loss"] = jnp.asarray(sv["cum_loss"], jnp.float32)
-        pipe._fitted_host = sv["fitted"]
+        _pipeline_load(net.pipeline, sv)
         net.holdout_count = sv["holdout_count"]
         for p in sv["test_set"]:
             net.test_set.append(p)
