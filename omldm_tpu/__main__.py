@@ -144,67 +144,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 def _run(job: StreamJob, flags: Dict[str, str]) -> int:
     if "kafkaBrokers" in flags:
-        if int(flags.get("restartAttempts", "0")) > 0:
-            # supervised recovery needs a REPLAYABLE source; a live Kafka
-            # consumer is not rewindable here, so say so instead of letting
-            # the flag silently do nothing
-            print(
-                "warning: --restartAttempts applies only to replayable "
-                "file sources; ignored with --kafkaBrokers",
-                file=sys.stderr,
-            )
-        from omldm_tpu.runtime.kafka_io import connect_kafka
-
-        events, producer_sinks = connect_kafka(flags["kafkaBrokers"])
-        # Kafka producers are the default egress; an explicitly-passed
-        # file sink keeps precedence over the producer for its stream
-        job.set_sinks(
-            on_prediction=(
-                None if "predictionsOut" in flags
-                else producer_sinks.on_prediction
-            ),
-            on_response=(
-                None if "responsesOut" in flags
-                else producer_sinks.on_response
-            ),
-            on_performance=(
-                None if "performanceOut" in flags
-                else producer_sinks.on_performance
-            ),
-        )
-        # bounded profile window for the unbounded stream: trace only the
-        # first --profileSteps events (default 1000)
-        profile_dir = flags.get("profileDir")
-        profile_steps = int(flags.get("profileSteps", "1000"))
-        tracing = False
-        if profile_dir:
-            import jax
-
-            jax.profiler.start_trace(profile_dir)
-            tracing = True
-        n_events = 0
-        # start the silence clock at loop entry so a broker that never
-        # delivers anything still terminates after the timeout
-        job.stats.mark_activity()
-        try:
-            for event in events:  # yields None on each idle poll window
-                if event is not None:
-                    job.process_event(*event)
-                    if job.checkpoint_manager is not None:
-                        job.checkpoint_manager.maybe_save(job)
-                    n_events += 1
-                    if tracing and n_events >= profile_steps:
-                        import jax
-
-                        jax.profiler.stop_trace()
-                        tracing = False
-                if job.check_silence() is not None:
-                    break
-        finally:
-            if tracing:
-                import jax
-
-                jax.profiler.stop_trace()
+        return _run_kafka(job, flags)
     elif "events" in flags:
         _run_replay(job, flags, lambda: combined_events(flags["events"]))
     else:
@@ -234,6 +174,131 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
 
         _run_replay(job, flags, make_events)
     return 0
+
+
+def _apply_kafka_sinks(job: StreamJob, flags: Dict[str, str], producer_sinks) -> None:
+    """Kafka producers are the default egress; an explicitly-passed file
+    sink keeps precedence over the producer for its stream."""
+    job.set_sinks(
+        on_prediction=(
+            None if "predictionsOut" in flags else producer_sinks.on_prediction
+        ),
+        on_response=(
+            None if "responsesOut" in flags else producer_sinks.on_response
+        ),
+        on_performance=(
+            None if "performanceOut" in flags else producer_sinks.on_performance
+        ),
+    )
+
+
+def _kafka_loop(job: StreamJob, events, flags: Dict[str, str], profile: Dict) -> None:
+    """One supervised attempt at the live polling loop. ``profile`` carries
+    the bounded trace-window state across restart attempts (the window
+    counts TOTAL events, and tracing stops exactly once)."""
+    # start the silence clock at loop entry so a broker that never
+    # delivers anything still terminates after the timeout
+    job.stats.mark_activity()
+    for event in events:  # yields None on each idle poll window
+        if event is not None:
+            job.process_event(*event)
+            if job.checkpoint_manager is not None:
+                job.checkpoint_manager.maybe_save(job)
+            profile["n_events"] += 1
+            if profile["tracing"] and profile["n_events"] >= profile["steps"]:
+                import jax
+
+                jax.profiler.stop_trace()
+                profile["tracing"] = False
+        job.check_silence()
+        if job.stats.terminated:
+            break
+
+
+def _run_kafka(job: StreamJob, flags: Dict[str, str]) -> int:
+    """The live Kafka job, optionally supervised (--restartAttempts N):
+    on failure, restore the latest checkpoint taken during this run and
+    seek the rebuilt consumer to the snapshot's (topic, partition) offsets
+    — Flink's restore-from-checkpoint with Kafka source offsets. Without a
+    usable snapshot the incarnation restarts fresh from the live position
+    (no replay), Flink's uncheckpointed behavior on a live source."""
+    import time as _time
+
+    from omldm_tpu.runtime.kafka_io import connect_kafka
+
+    attempts = int(flags.get("restartAttempts", "0"))
+    delay_s = float(flags.get("restartDelayMs", "0")) / 1000.0
+    # bounded profile window for the unbounded stream: trace only the
+    # first --profileSteps events (default 1000)
+    profile = {
+        "tracing": False,
+        "n_events": 0,
+        "steps": int(flags.get("profileSteps", "1000")),
+    }
+    if flags.get("profileDir"):
+        import jax
+
+        jax.profiler.start_trace(flags["profileDir"])
+        profile["tracing"] = True
+
+    manager = job.checkpoint_manager
+    ckpt_floor = manager.latest_path() if manager is not None else None
+    tracker: Dict = {}
+    events, producer_sinks = connect_kafka(
+        flags["kafkaBrokers"], tracker=tracker
+    )
+    failures = 0
+    try:
+        while True:
+            job.source_position = tracker
+            _apply_kafka_sinks(job, flags, producer_sinks)
+            try:
+                _kafka_loop(job, events, flags, profile)
+                return 0
+            except Exception as exc:
+                failures += 1
+                if failures > attempts:
+                    raise
+                print(
+                    f"job failure ({type(exc).__name__}: {exc}); "
+                    f"restart {failures}/{attempts}",
+                    file=sys.stderr,
+                )
+                if delay_s > 0:
+                    _time.sleep(delay_s)
+                from omldm_tpu.runtime.recovery import recover_job
+
+                job, restored_from = recover_job(job, ckpt_floor)
+                if job.source_position is None:
+                    # fresh incarnation: data streams continue from the
+                    # live position (no replay on a live source), but the
+                    # CONTROL stream rewinds to the beginning — a
+                    # fresh-state job must re-consume Create/Update/Delete
+                    # requests to rebuild its topology (the reference's
+                    # topology is part of the submitted job graph; here it
+                    # is request-driven). Dropping the key makes the
+                    # reconnect seek those partitions to the beginning.
+                    position = dict(tracker)
+                    from omldm_tpu.runtime.kafka_io import DEFAULT_TOPICS
+
+                    for key in list(position):
+                        if DEFAULT_TOPICS.get(key[0]) == REQUEST_STREAM:
+                            del position[key]
+                    job.source_position = position
+                tracker = dict(job.source_position)
+                # close the abandoned clients: restarts must not leak
+                # broker connections / fetcher threads
+                producer_sinks.close()
+                events, producer_sinks = connect_kafka(
+                    flags["kafkaBrokers"],
+                    position=tracker,
+                    tracker=tracker,
+                )
+    finally:
+        if profile["tracing"]:
+            import jax
+
+            jax.profiler.stop_trace()
 
 
 def _run_replay(job: StreamJob, flags: Dict[str, str], make_events) -> None:

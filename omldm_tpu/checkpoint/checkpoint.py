@@ -172,6 +172,7 @@ class CheckpointManager:
             # subsequent records exactly as the original would have (the
             # role of source offsets in a Flink checkpoint barrier)
             "offset": job.events_processed,
+            "source_position": copy.deepcopy(job.source_position),
             "rr": job._rr,
             "backlog": list(job._backlog),
             "backlog_rows": job._backlog_rows,
@@ -243,6 +244,7 @@ class CheckpointManager:
 
         # stream position + routing continuity (resume-from-offset replay)
         job.events_processed = snapshot.get("offset", 0)
+        job.source_position = snapshot.get("source_position")
         job._rr = snapshot.get("rr", 0)
         import collections as _collections
 

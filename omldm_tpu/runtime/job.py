@@ -94,6 +94,11 @@ class StreamJob:
         # the snapshot covers (the role of Flink's source offsets in a
         # checkpoint barrier; runtime.recovery.JobSupervisor)
         self.events_processed = 0
+        # external source position (e.g. Kafka (topic, partition) -> next
+        # offset, maintained by kafka_io.polling_events' tracker): if a
+        # source sets this, checkpoints carry it and recovery seeks the
+        # rebuilt source here instead of counting events
+        self.source_position: Optional[dict] = None
         # pipelines deployed on the SPMD collective engine instead of the
         # host plane (trainingConfiguration {"engine": "spmd"})
         self.spmd_bridges: Dict[int, Any] = {}

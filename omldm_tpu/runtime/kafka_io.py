@@ -70,6 +70,7 @@ def consumer_events(
 def polling_events(
     consumer: Any,
     topic_map: Optional[Mapping[str, str]] = None,
+    tracker: Optional[dict] = None,
 ) -> Iterator[Optional[Tuple[str, str]]]:
     """Adapt a poll-style Kafka consumer into a NEVER-ENDING event iterable
     that yields ``None`` whenever a poll window elapses with no message.
@@ -78,7 +79,13 @@ def polling_events(
     on an idle window (kafka-python's behavior when ``consumer_timeout_ms``
     is set; each subsequent ``next`` resumes fetching). The ``None`` idle
     markers let the driver run the silence-timer termination check
-    (StatisticsOperator.scala:135-142) even when the broker goes quiet."""
+    (StatisticsOperator.scala:135-142) even when the broker goes quiet.
+
+    ``tracker`` (a mutable dict) records the NEXT offset to read per
+    ``(topic, partition)`` as records are consumed — the source-position
+    side of a checkpoint (what a Flink checkpoint barrier snapshots from
+    its Kafka sources), enabling seek-and-replay recovery. Records without
+    an ``offset`` attribute advance a per-partition counter instead."""
     topic_map = dict(topic_map or DEFAULT_TOPICS)
     while True:
         try:
@@ -86,6 +93,12 @@ def polling_events(
         except StopIteration:
             yield None
             continue
+        if tracker is not None:
+            key = (record.topic, getattr(record, "partition", 0))
+            offset = getattr(record, "offset", None)
+            if offset is None:
+                offset = tracker.get(key, 0)
+            tracker[key] = offset + 1
         event = _record_to_event(record, topic_map)
         if event is not None:
             yield event
@@ -95,15 +108,26 @@ class ProducerSinks:
     """Producer-backed sinks for predictions / responses / performance.
 
     ``producer`` must expose ``send(topic, value: bytes)`` (kafka-python
-    shape). Returns the three callbacks StreamJob accepts."""
+    shape). Returns the three callbacks StreamJob accepts. ``consumer``,
+    when provided, is owned too: :meth:`close` shuts both down (used by
+    supervised recovery before rebuilding the clients, so restarts do not
+    leak broker connections)."""
 
     def __init__(
         self,
         producer: Any,
         out_topics: Optional[Mapping[str, str]] = None,
+        consumer: Any = None,
     ):
         self.producer = producer
+        self.consumer = consumer
         self.topics = dict(out_topics or DEFAULT_OUT_TOPICS)
+
+    def close(self) -> None:
+        for client in (self.consumer, self.producer):
+            close = getattr(client, "close", None)
+            if close is not None:
+                close()
 
     def _send(self, topic_key: str, obj: Any) -> None:
         payload = obj.to_json() if hasattr(obj, "to_json") else json.dumps(obj)
@@ -124,12 +148,24 @@ def connect_kafka(
     topic_map: Optional[Mapping[str, str]] = None,
     out_topics: Optional[Mapping[str, str]] = None,
     poll_timeout_ms: int = 1000,
+    position: Optional[Mapping[Tuple[str, int], int]] = None,
+    tracker: Optional[dict] = None,
 ) -> Tuple[Iterator[Optional[Tuple[str, str]]], "ProducerSinks"]:
     """Wire real Kafka clients. Requires kafka-python or confluent_kafka;
     raises ImportError with guidance otherwise (neither library ships in
-    this image — use file replay / in-memory events instead)."""
+    this image — use file replay / in-memory events instead).
+
+    ``position`` (a checkpoint's ``source_position``): manually assign the
+    UNION of the topic map's partitions — partitions with a recorded
+    next-offset seek there (seek-and-replay recovery, the consumer side of
+    Flink's restore-from-checkpoint); partitions the snapshot never saw a
+    record from seek to the beginning (nothing from them was consumed).
+    Under manual assignment, partitions created after the reconnect are
+    not picked up (same caveat as Flink restore without partition
+    discovery). ``tracker`` is threaded through to
+    :func:`polling_events`."""
     try:
-        from kafka import KafkaConsumer, KafkaProducer  # type: ignore
+        from kafka import KafkaConsumer, KafkaProducer, TopicPartition  # type: ignore
     except ImportError as e:
         raise ImportError(
             "Kafka transport needs the 'kafka-python' package (or adapt "
@@ -141,10 +177,35 @@ def connect_kafka(
     # consumer_timeout_ms bounds each poll so the iterator goes idle (raises
     # StopIteration, resumable) instead of blocking forever — required for
     # the silence-timer termination to ever fire on a quiet broker
-    consumer = KafkaConsumer(
-        *topic_map.keys(),
-        bootstrap_servers=brokers,
-        consumer_timeout_ms=poll_timeout_ms,
-    )
+    if position is not None:
+        consumer = KafkaConsumer(
+            bootstrap_servers=brokers,
+            consumer_timeout_ms=poll_timeout_ms,
+        )
+        # union of the subscribed topics' partitions: a topic that never
+        # delivered a record before the snapshot must still be consumed
+        assigned = []
+        for topic in topic_map:
+            parts = consumer.partitions_for_topic(topic) or {0}
+            assigned.extend(TopicPartition(topic, p) for p in parts)
+        for (t, p) in position:
+            if TopicPartition(t, p) not in assigned:
+                assigned.append(TopicPartition(t, p))
+        consumer.assign(assigned)
+        for tp in assigned:
+            offset = position.get((tp.topic, tp.partition))
+            if offset is not None:
+                consumer.seek(tp, offset)
+            else:
+                consumer.seek_to_beginning(tp)
+    else:
+        consumer = KafkaConsumer(
+            *topic_map.keys(),
+            bootstrap_servers=brokers,
+            consumer_timeout_ms=poll_timeout_ms,
+        )
     producer = KafkaProducer(bootstrap_servers=brokers)
-    return polling_events(consumer, topic_map), ProducerSinks(producer, out_topics)
+    return (
+        polling_events(consumer, topic_map, tracker=tracker),
+        ProducerSinks(producer, out_topics, consumer=consumer),
+    )

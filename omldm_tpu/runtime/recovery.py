@@ -68,6 +68,29 @@ def skip_events(events: Iterable[Event], n: int) -> Iterator[Event]:
     yield from it
 
 
+def recover_job(
+    failed: StreamJob, ckpt_floor: Optional[str] = None
+) -> Tuple[StreamJob, Optional[str]]:
+    """Build a failed job's next incarnation: restore the latest checkpoint
+    newer than ``ckpt_floor`` (pre-existing snapshots from an earlier run
+    are never restored), else a fresh job from the original config. Sinks
+    carry over. Returns (job, restored_from_path_or_None)."""
+    manager = failed.checkpoint_manager
+    path = manager.latest_path() if manager is not None else None
+    if path == ckpt_floor:
+        path = None  # pre-existing snapshot from an earlier run
+    if path is not None:
+        job = manager.restore(path=path)
+    else:
+        job = StreamJob(copy.deepcopy(failed.config))
+    job.set_sinks(
+        on_prediction=failed._on_prediction,
+        on_response=failed._on_response,
+        on_performance=failed._on_performance,
+    )
+    return job, path
+
+
 def replayable(make_events: Callable[[], Iterable[Event]]) -> SourceFactory:
     """Lift a zero-argument source constructor (e.g. re-opening the same
     files) into a :data:`SourceFactory` by skipping already-consumed
@@ -138,20 +161,7 @@ class JobSupervisor:
     def _recover(self, failed: StreamJob, record: FailureRecord) -> StreamJob:
         """Build the next incarnation: restore the latest checkpoint when
         one exists, else a fresh job from the original config (offset 0)."""
-        manager = failed.checkpoint_manager
-        path = manager.latest_path() if manager is not None else None
-        if path == self._ckpt_floor:
-            path = None  # pre-existing snapshot from an earlier run
-        if path is not None:
-            job = manager.restore(path=path)
-            record.restored_from = path
-        else:
-            job = StreamJob(copy.deepcopy(failed.config))
-        job.set_sinks(
-            on_prediction=failed._on_prediction,
-            on_response=failed._on_response,
-            on_performance=failed._on_performance,
-        )
+        job, record.restored_from = recover_job(failed, self._ckpt_floor)
         return job
 
 

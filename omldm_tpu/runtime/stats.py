@@ -66,8 +66,12 @@ class StatisticsCollector:
     def try_finalize(self, n_pipelines: int) -> Optional[JobStatistics]:
         """Emit JobStatistics once every worker reported for every pipeline
         (count reaches parallelism x #pipelines, StatisticsOperator.scala:109)."""
-        if self.terminated or n_pipelines == 0:
+        if self.terminated:
             return None
+        # a probe over ZERO live pipelines is immediately satisfied (the
+        # parallelism x #pipelines countdown is 0): finalize with empty
+        # statistics instead of leaving the job unterminatable — a live
+        # loop would otherwise spin forever on a pipeline-less job
         total = sum(len(v) for v in self._terminate_fragments.values())
         if total < self.config.parallelism * n_pipelines:
             return None

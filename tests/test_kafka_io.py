@@ -20,6 +20,8 @@ from omldm_tpu.runtime.kafka_io import (
 class FakeRecord:
     topic: str
     value: bytes
+    partition: int = 0
+    offset: int = None  # None -> polling_events falls back to a counter
 
 
 class FakeProducer:
