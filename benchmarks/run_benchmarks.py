@@ -309,27 +309,87 @@ def bench_flash_attention(steps):
     v = jnp.asarray(rng.randn(b, l, h, dh).astype(np.float32) * 0.1)
     flops = 4 * b * h * l * l * dh / 2  # causal half
 
-    def time_fn(fn, rounds=5):
-        jax.block_until_ready(fn())
-        t0 = time.perf_counter()
-        for _ in range(rounds):
-            o = fn()
-        jax.block_until_ready(o)
-        return (time.perf_counter() - t0) / rounds
+    def measure_round_trip(x0):
+        """One trivial jitted scalar fetch: the fixed dispatch + tunnel
+        round-trip cost that chain_time must subtract so slow and fast
+        kernels are not amortized unequally."""
 
-    t_lax = time_fn(lambda: blockwise_attention(q, k, v, causal=True))
+        @jax.jit
+        def rt(x):
+            return x.sum()
+
+        float(np.asarray(rt(x0)))  # compile + warm
+        t0 = time.perf_counter()
+        float(np.asarray(rt(x0)))
+        return time.perf_counter() - t0
+
+    def chain_time(apply, x0, chain):
+        """Time ``chain`` data-dependent applications inside ONE jitted
+        program, materializing a scalar: robust against async-dispatch
+        artifacts (per-call timings through this environment's TPU tunnel
+        can read near zero). The measured fixed round trip is subtracted
+        before dividing, so comparisons between kernels of different
+        speeds are not skewed by the per-launch overhead."""
+
+        @jax.jit
+        def run(x):
+            def body(c, _):
+                return apply(c), ()
+
+            c, _ = jax.lax.scan(body, x, None, length=chain)
+            return c.sum()
+
+        float(np.asarray(run(x0)))  # compile + warm
+        t0 = time.perf_counter()
+        float(np.asarray(run(x0)))  # scalar fetch = full completion barrier
+        total = time.perf_counter() - t0
+        return max(total - measure_round_trip(x0), 1e-9) / chain
+
+    # chain long enough that kernel time dwarfs the ~RT-scale noise left
+    # after the round-trip subtraction; EQUAL on both sides for fairness
+    t_lax = chain_time(
+        lambda x: blockwise_attention(x, k, v, causal=True), q, chain=32
+    )
     if on_tpu:
-        t_pl = time_fn(
-            lambda: flash_attention_pallas(q, k, v, causal=True)
+        t_pl = chain_time(
+            lambda x: flash_attention_pallas(x, k, v, causal=True), q,
+            chain=32,
         )
     else:  # interpret mode is not a performance path; report lax only
         t_pl = t_lax
+    # TRAINING path: forward + backward through the custom VJP (the Pallas
+    # dq and dk/dv kernels recomputing scores from the saved logsumexp) vs
+    # the lax blockwise VJP. Backward FLOPs ~ 2.5x forward (+1x for the
+    # fwd pass the grad call re-runs). Measured at batch 1: the lax VJP's
+    # saved score-sized temporaries OOM HBM at batch 4 / L=8192 (exactly
+    # the blowup the kernel's recompute-from-logsumexp avoids).
+    from omldm_tpu.ops.attention import attention
+
+    q1, k1, v1 = q[:1], k[:1], v[:1]
+
+    def grad_apply(use_pallas):
+        g = jax.grad(
+            lambda q_: attention(
+                q_, k1, v1, causal=True, use_pallas=use_pallas
+            ).sum()
+        )
+        return lambda x: g(x)  # dq has q's shape: chainable
+
+    bwd_flops = (flops / b) * 3.5
+    t_lax_g = chain_time(grad_apply(False), q1, chain=64)
+    t_pl_g = (
+        chain_time(grad_apply(True), q1, chain=64) if on_tpu else t_lax_g
+    )
     return "flash_attention_L8192", flops / t_pl / 1e12, {
         "pallas_ms": round(t_pl * 1000, 2),
         "lax_blockwise_ms": round(t_lax * 1000, 2),
         "lax_blockwise_tflops": round(flops / t_lax / 1e12, 2),
         "speedup_vs_lax": round(t_lax / t_pl, 1),
         "pallas_compiled": on_tpu,
+        "train_fwdbwd_pallas_ms": round(t_pl_g * 1000, 2),
+        "train_fwdbwd_lax_ms": round(t_lax_g * 1000, 2),
+        "train_fwdbwd_pallas_tflops": round(bwd_flops / t_pl_g / 1e12, 2),
+        "train_speedup_vs_lax": round(t_lax_g / t_pl_g, 1),
     }
 
 

@@ -124,9 +124,38 @@ def _ensure_backend() -> None:
         jax.devices()
 
 
+def _enable_compile_cache(flags: Dict[str, str]) -> None:
+    """Persistent XLA compilation cache: first TPU compiles cost tens of
+    seconds; caching them on disk makes every later job launch start hot.
+    ``--compileCache off`` disables; ``--compileCache <dir>`` relocates
+    (default ~/.cache/omldm_tpu/xla)."""
+    import os
+
+    cache = flags.get(
+        "compileCache",
+        os.path.join(os.path.expanduser("~"), ".cache", "omldm_tpu", "xla"),
+    )
+    if cache == "off":
+        return
+    import jax
+
+    try:
+        # parse BEFORE any config.update: a bad value must leave the cache
+        # fully disabled, not half-configured
+        min_secs = float(flags.get("compileCacheMinSecs", "1.0"))
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_secs
+        )
+    except Exception as exc:  # cache is an optimization, never fatal
+        print(f"warning: compile cache disabled ({exc})", file=sys.stderr)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     flags = parse_flags(sys.argv[1:] if argv is None else argv)
     _ensure_backend()
+    _enable_compile_cache(flags)
     job, sinks = build_job(flags)
     from omldm_tpu.utils import trace
 

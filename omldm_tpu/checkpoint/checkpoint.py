@@ -102,10 +102,23 @@ def _pipeline_load(pipe, sv: dict) -> None:
 
 
 class CheckpointManager:
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._last_save = 0.0
+        # seed the sequence past any snapshots already in the directory: a
+        # manager built mid-recovery (restore() constructs a fresh
+        # StreamJob) must not reuse a live sequence number — a
+        # same-millisecond collision would overwrite (or name-sort before)
+        # the newest snapshot and let _prune delete what `latest` points at
+        self._seq = 0
+        for name in os.listdir(directory):
+            if name.startswith("ckpt_") and name.endswith(".pkl"):
+                parts = name[:-4].split("_")
+                if len(parts) == 3 and parts[2].isdigit():
+                    self._seq = max(self._seq, int(parts[2]))
+        # snapshots retained on disk; <= 0 keeps everything
+        self.keep = keep
 
     # --- save ---
 
@@ -179,13 +192,35 @@ class CheckpointManager:
             "pending_creates": [r.to_dict() for r in job._pending_creates],
             "time": time.time(),
         }
-        path = os.path.join(self.directory, f"ckpt_{int(time.time()*1000)}.pkl")
+        # ms timestamp + monotonic sequence: unique, name-sortable names
+        # even when saves land inside the same millisecond
+        self._seq += 1
+        path = os.path.join(
+            self.directory,
+            f"ckpt_{int(time.time()*1000):013d}_{self._seq:06d}.pkl",
+        )
         with open(path, "wb") as f:
             pickle.dump(snapshot, f)
         with open(os.path.join(self.directory, "latest"), "w") as f:
             f.write(os.path.basename(path))
         self._last_save = time.time()
+        self._prune()
         return path
+
+    def _prune(self) -> None:
+        """Retain the newest ``keep`` snapshots (file names sort
+        chronologically); <= 0 keeps everything."""
+        if self.keep <= 0:
+            return
+        snaps = sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith("ckpt_") and f.endswith(".pkl")
+        )
+        for stale in snaps[: -self.keep]:
+            try:
+                os.remove(os.path.join(self.directory, stale))
+            except OSError:
+                pass
 
     @staticmethod
     def _batcher_contents(batcher) -> List[tuple]:

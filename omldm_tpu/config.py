@@ -48,6 +48,10 @@ class JobConfig:
     checkpointing: bool = False
     check_interval_ms: int = 5_000
     checkpoint_dir: str = "/tmp/omldm_tpu_checkpoints"
+    # snapshots retained on disk (oldest pruned after each save); <= 0
+    # keeps everything. Recovery only ever restores the latest, but a
+    # couple of spares survive a torn write of the newest file.
+    checkpoint_keep: int = 3
 
     # --- capacity limits (host-side buffering) ---
     # Spoke training-record buffer cap (SpokeLogic.scala:32).

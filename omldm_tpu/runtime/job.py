@@ -107,7 +107,10 @@ class StreamJob:
         if self.config.checkpointing:
             from omldm_tpu.checkpoint import CheckpointManager
 
-            self.checkpoint_manager = CheckpointManager(self.config.checkpoint_dir)
+            self.checkpoint_manager = CheckpointManager(
+                self.config.checkpoint_dir,
+                keep=getattr(self.config, "checkpoint_keep", 3),
+            )
 
     # --- sinks ---
 

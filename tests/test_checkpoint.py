@@ -199,3 +199,56 @@ class TestStatisticsContinuity:
         # the merged replicas may retrain overflow records (which adds loss),
         # so the restored sum is at least the saved sum
         assert got >= total * (1 - 1e-6)
+
+
+class TestRetention:
+    def test_prunes_to_keep_newest(self, tmp_path):
+        job = trained_job(tmp_path, parallelism=2, n=400)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+        paths = [mgr.save(job) for _ in range(7)]
+        import os
+
+        snaps = sorted(
+            f for f in os.listdir(tmp_path / "ck")
+            if f.startswith("ckpt_") and f.endswith(".pkl")
+        )
+        assert len(snaps) == 3
+        # the retained set is the newest three, and latest still restores
+        assert snaps[-1] == os.path.basename(paths[-1])
+        assert mgr.latest_path().endswith(snaps[-1])
+        mgr.restore()
+
+    def test_same_millisecond_saves_do_not_collide(self, tmp_path):
+        job = trained_job(tmp_path, parallelism=2, n=400)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)
+        paths = {mgr.save(job) for _ in range(5)}
+        assert len(paths) == 5  # unique names even within one ms
+
+    def test_keep_zero_retains_everything(self, tmp_path):
+        job = trained_job(tmp_path, parallelism=2, n=400)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)
+        for _ in range(5):
+            mgr.save(job)
+        import os
+
+        snaps = [
+            f for f in os.listdir(tmp_path / "ck") if f.endswith(".pkl")
+        ]
+        assert len(snaps) == 5
+
+    def test_sequence_survives_new_manager_on_same_dir(self, tmp_path):
+        """A manager built mid-recovery on a live directory must continue
+        the name sequence: its first save must sort after (never collide
+        with) the existing snapshots, or pruning could delete the file
+        `latest` points at."""
+        import os
+
+        job = trained_job(tmp_path, parallelism=2, n=400)
+        m1 = CheckpointManager(str(tmp_path / "ck"), keep=2)
+        m1.save(job)
+        p2 = m1.save(job)
+        m2 = CheckpointManager(str(tmp_path / "ck"), keep=2)
+        p3 = m2.save(job)
+        assert os.path.basename(p3) > os.path.basename(p2)
+        assert m2.latest_path() == p3
+        m2.restore()  # latest survived pruning

@@ -120,3 +120,35 @@ class TestFileReplayJob:
     def test_no_sources_exits(self):
         with pytest.raises(SystemExit):
             main(["--parallelism", "2"])
+
+
+class TestCompileCache:
+    def test_compile_cache_flag_configures_jax(self, tmp_path, monkeypatch):
+        """--compileCache <dir> turns on the persistent XLA compilation
+        cache; 'off' leaves it untouched."""
+        import jax
+
+        from omldm_tpu.__main__ import _enable_compile_cache
+
+        before_dir = jax.config.jax_compilation_cache_dir
+        before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        cache = tmp_path / "xla"
+        try:
+            _enable_compile_cache({"compileCache": str(cache),
+                                   "compileCacheMinSecs": "0.0"})
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+            assert cache.is_dir()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", before_min
+            )
+
+    def test_compile_cache_off(self, monkeypatch):
+        import jax
+
+        from omldm_tpu.__main__ import _enable_compile_cache
+
+        before = jax.config.jax_compilation_cache_dir
+        _enable_compile_cache({"compileCache": "off"})
+        assert jax.config.jax_compilation_cache_dir == before
