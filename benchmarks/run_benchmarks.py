@@ -182,22 +182,42 @@ def bench_longctx_transformer(steps):
     (the multi-chip sp/tp/pp paths are validated on the virtual CPU mesh;
     this measures the single-chip compute path with the dispatched
     flash-attention kernel)."""
-    import jax
+    return _longctx_bench(
+        "longctx_transformer_lm", steps, max_len=1024, b=8, t=8
+    )
+
+
+def bench_longctx_transformer_4k(steps):
+    """Attention-dominant regime: the same LM at 4096-token context,
+    training through the Pallas flash forward+backward kernels (at this
+    length attention is the majority of the step FLOPs)."""
+    return _longctx_bench(
+        "longctx_transformer_lm_L4096", steps, max_len=4096, b=2, t=4
+    )
+
+
+def _longctx_bench(name, steps, max_len, b, t):
+    """One shared LM (only context length and batch vary between the
+    configs, so the L1024 vs L4096 comparison stays apples-to-apples)."""
+    import jax.numpy as jnp
 
     from omldm_tpu.models.transformer import TransformerConfig
     from omldm_tpu.parallel.seq_trainer import SeqTrainer, make_seq_mesh
 
-    import jax.numpy as jnp
-
     cfg = TransformerConfig(
         vocab_size=8192, d_model=256, n_heads=8, n_layers=4, d_ff=1024,
-        max_len=1024, dtype=jnp.bfloat16,  # fp32 master weights, bf16 compute
+        max_len=max_len, dtype=jnp.bfloat16,  # fp32 master, bf16 compute
     )
-    b, l = 8, 1024
     trainer = SeqTrainer(cfg, mesh=make_seq_mesh(1, 1, 1), lr=1e-3)
     rng = np.random.RandomState(0)
-    t = 8
-    tokens = rng.randint(0, 8192, size=(t, b, l)).astype(np.int32)
+    tokens = rng.randint(0, 8192, size=(t, b, max_len)).astype(np.int32)
+    return _longctx_run(trainer, tokens, steps, name)
+
+
+def _longctx_run(trainer, tokens, steps, name):
+    import jax
+
+    t, b, l = tokens.shape
     targets = np.roll(tokens, -1, axis=2)
     masks = np.ones((t, b, l), np.float32)
     counts = masks.sum(axis=(1, 2))
@@ -215,7 +235,7 @@ def bench_longctx_transformer(steps):
         losses = trainer.step_many(tokens_d, targets_d, masks_d, valid_counts=counts)
     float(np.asarray(losses[-1]))  # materialize: full end-to-end barrier
     thr = rounds * t * b * l / (time.perf_counter() - t0)
-    return "longctx_transformer_lm", thr
+    return name, thr
 
 
 def _bench_sparse(name, learner_spec, dim, k, steps, batch=4096):
@@ -630,6 +650,19 @@ def main():
     ap.add_argument("--e2e-records", type=int, default=300_000)
     args = ap.parse_args()
 
+    # persistent XLA compile cache: the suite's first-compile cost (tens of
+    # seconds per program on TPU) drops out of repeat runs
+    try:
+        import jax
+
+        cache = os.path.join(
+            os.path.expanduser("~"), ".cache", "omldm_tpu", "xla"
+        )
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except Exception:
+        pass
+
     for fn in (
         bench_higgs_lr,
         bench_msd_orr,
@@ -639,6 +672,7 @@ def main():
         bench_criteo_sparse_pa,
         bench_avazu_sparse_softmax,
         bench_longctx_transformer,
+        bench_longctx_transformer_4k,
         bench_flash_attention,
     ):
         out = fn(args.steps)

@@ -342,3 +342,34 @@ class TestCLIRecoveryFlags:
         assert calls["n"] == 1
         out = json.loads(perf.read_text().strip().splitlines()[-1])
         assert out["statistics"][0]["fitted"] > 0
+
+
+class TestRescaleRecoveryInterplay:
+    def test_recover_after_live_rescale_restores_new_parallelism(
+        self, tmp_path
+    ):
+        """A live rescale mid-stream changes config.parallelism; a
+        checkpoint taken AFTER it must restore the rescaled worker count
+        and keep training through recovery."""
+        events = make_events(n=1200)
+        job = checkpointed_job(tmp_path, parallelism=2)
+        # the supervisor's stale-snapshot floor is recorded at construction:
+        # build it BEFORE the deliberate post-rescale checkpoint so that
+        # snapshot is above the floor and genuinely restorable
+        sup = JobSupervisor(job, replayable(lambda: list(events)))
+        # consume half the stream, rescale live, checkpoint, then crash
+        job.run(list(events)[:600], terminate_on_end=False)
+        job.rescale(4)
+        assert len(job.spokes) == 4
+        job.checkpoint_manager.maybe_save(job)  # interval 0: saves now
+
+        fault = FaultInjector()
+        fault.arm(job, worker_id=3, after_records=30)
+        report = sup.run()
+        assert fault.fired == 1
+        assert sup.failures[0].restored_from is not None
+        assert len(sup.job.spokes) == 4
+        assert sup.job.config.parallelism == 4
+        [stats] = report.statistics
+        assert stats.score > 0.8
+        assert sup.job.events_processed == len(events)
