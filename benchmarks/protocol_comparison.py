@@ -1,0 +1,130 @@
+"""Protocol comparison at the reference's own operating point.
+
+The reference project's core experiment is comparing distributed online
+learning protocols (its 8 worker/PS pairs, MLNodeGenerator.scala:20-76) on
+throughput, communication traffic, and accuracy at job parallelism 16 (its
+default, DefaultJobParameters.scala:5, observed live in
+hs_err_pid77107.log:21). This harness reproduces that comparison on the
+host plane of the streaming runtime: one identical synthetic stream
+(BASELINE config-1 shape: 28 numeric features, linearly separable), one
+StreamJob per protocol, measuring end-to-end examples/sec, final holdout
+score, and the hub-side communication accounting (bytesShipped /
+modelsShipped / numOfBlocks, FlinkHub.scala:118-127).
+
+Runs on the CPU backend: the host plane's per-batch dispatch is what is
+being compared (protocol logic + message traffic), and this environment's
+TPU network tunnel would add a ~65 ms round trip per dispatch that no real
+deployment pays.
+
+Usage: python benchmarks/protocol_comparison.py [--records N]
+Prints ONE JSON line: {"config": "protocol_comparison_host_plane", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+PROTOCOLS = (
+    "Asynchronous",
+    "Synchronous",
+    "SSP",
+    "EASGD",
+    "GM",
+    "FGM",
+    "CentralizedTraining",
+    "SingleLearner",
+)
+
+
+def run_one(protocol: str, x, y, parallelism: int, batch: int):
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    n = x.shape[0]
+    job = StreamJob(
+        JobConfig(
+            parallelism=parallelism, batch_size=batch, test_set_size=64
+        )
+    )
+    create = {
+        "id": 0,
+        "request": "Create",
+        "learner": {
+            "name": "PA",
+            "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": int(x.shape[1])},
+        },
+        "trainingConfiguration": {"protocol": protocol, "syncEvery": 4},
+    }
+    job.process_event(REQUEST_STREAM, json.dumps(create))
+    op = np.zeros((n,), np.uint8)
+    chunk = 8192
+    t0 = time.perf_counter()
+    for i in range(0, n, chunk):
+        job.process_packed_batch(
+            x[i : i + chunk], y[i : i + chunk], op[i : i + chunk]
+        )
+    report = job.terminate()
+    elapsed = time.perf_counter() - t0
+    [stats] = report.statistics
+    return {
+        "examples_per_sec": round(n / elapsed, 1),
+        "score": round(stats.score, 4),
+        "fitted": stats.fitted,
+        "bytes_shipped": stats.bytes_shipped,
+        "models_shipped": stats.models_shipped,
+        "num_of_blocks": stats.num_of_blocks,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=50_000)
+    ap.add_argument("--parallelism", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+
+    import jax
+
+    # host-plane comparison: protocol logic + traffic, not chip perf (and
+    # not this environment's per-dispatch tunnel round trip)
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    w = np.random.RandomState(42).randn(28)
+    x = rng.randn(args.records, 28).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    # untimed warmup: the jitted fit/eval/chained-fit programs are shared
+    # by (learner, dim, batch) spec, so one run compiles for all — sized
+    # for several full batches per worker so the blocked-batch chain
+    # program compiles too (it only traces once >= 2 batches are pending)
+    warm = min(args.parallelism * args.batch * 4, args.records)
+    run_one(PROTOCOLS[0], x[:warm], y[:warm], args.parallelism, args.batch)
+
+    out = {}
+    for protocol in PROTOCOLS:
+        out[protocol] = run_one(protocol, x, y, args.parallelism, args.batch)
+    print(
+        json.dumps(
+            {
+                "config": "protocol_comparison_host_plane",
+                "metric": "per-protocol examples/sec, score, traffic",
+                "parallelism": args.parallelism,
+                "records": args.records,
+                "protocols": out,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -693,6 +693,36 @@ def main():
                 }
             )
         )
+    # the reference's core experiment — 8 protocols compared on one
+    # stream at parallelism 16 — runs in a subprocess so its CPU-backend
+    # choice cannot disturb this process's TPU state
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child_path = repo_root + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else ""
+    )
+    try:
+        proto = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "protocol_comparison.py")],
+            capture_output=True, text=True, timeout=1800,
+            env={**os.environ, "PYTHONPATH": child_path},
+        )
+        if proto.returncode != 0:
+            print(
+                "protocol_comparison failed "
+                f"(rc {proto.returncode}):\n{proto.stderr[-2000:]}",
+                file=sys.stderr,
+            )
+        for line in proto.stdout.splitlines():
+            if line.startswith("{"):
+                print(line)
+    except subprocess.TimeoutExpired:
+        print("protocol_comparison timed out (1800s)", file=sys.stderr)
+
     name, thr, extra = bench_e2e_stream(n_records=args.e2e_records)
     print(
         json.dumps(

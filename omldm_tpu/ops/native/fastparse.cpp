@@ -114,7 +114,17 @@ struct Cursor {
 };
 
 inline void skip_ws(Cursor& c) {
-  while (c.p < c.end && (*c.p == ' ' || *c.p == '\t')) ++c.p;
+  // JSON's own whitespace set (what json.loads allows BETWEEN tokens)
+  while (c.p < c.end && (*c.p == ' ' || *c.p == '\t' || *c.p == '\n' ||
+                         *c.p == '\r'))
+    ++c.p;
+}
+
+// Python str.strip() whitespace (ASCII subset): what the codec strips off
+// the EDGES of a line before json.loads (DataInstance.from_json)
+inline bool is_edge_ws(char ch) {
+  return ch == ' ' || ch == '\t' || ch == '\r' || ch == '\f' ||
+         ch == '\v' || (ch >= '\x1c' && ch <= '\x1f');
 }
 
 // JSON-number parse: [-]digits[.digits][e[±]dd]. Falls back to strtod when
@@ -124,10 +134,16 @@ inline bool parse_number(Cursor& c, double* out) {
   const char* p = c.p;
   const char* end = c.end;
   bool neg = false;
-  if (p < end && (*p == '-' || *p == '+')) {
-    neg = (*p == '-');
+  // strict JSON (json.loads/Jackson parity): a leading '+' is invalid
+  if (p < end && *p == '+') return false;
+  if (p < end && *p == '-') {
+    neg = true;
     ++p;
   }
+  // strict JSON grammar: the integer part needs >= 1 digit and no
+  // leading zero — ".5", "-.5", "01" are json.loads drops
+  if (p >= end || *p < '0' || *p > '9') return false;
+  if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9') return false;
   uint64_t mant = 0;
   int digits = 0;
   int frac = 0;
@@ -145,7 +161,10 @@ inline bool parse_number(Cursor& c, double* out) {
       int fr = rest ? static_cast<int>(__builtin_ctzll(rest)) >> 3 : 6;
       bool full_window = (fr == 6);
       // a full window might truncate a longer fraction: only take the fast
-      // path when the byte after the window cannot extend the number
+      // path when the byte after the window cannot extend the number.
+      // fr == 0 ("1.,") falls through to the slow path, which rejects a
+      // dot with no fraction digits (json.loads parity).
+      if (fr > 0)
       if (!full_window ||
           (end - p > 8 && !(p[8] >= '0' && p[8] <= '9') && p[8] != '.') ||
           end - p == 8) {
@@ -170,6 +189,7 @@ inline bool parse_number(Cursor& c, double* out) {
   if (p < end && *p == '.') {
     ++p;
     frac = parse_digit_run(p, end, mant);
+    if (frac == 0) return false;  // "1." is a json.loads drop
     digits += frac;
   }
 have_mantissa:;
@@ -285,21 +305,50 @@ inline KeyId match_key(const char* k, size_t len) {
 }
 
 // Skip a string; cursor sits on the opening '"'. Handles escapes.
+inline bool ishex(char h) {
+  return (h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+         (h >= 'A' && h <= 'F');
+}
+
+// Strict-JSON string scan (json.loads parity): raw control characters
+// (< 0x20) must be escaped, and only the JSON escapes \" \\ \/ \b \f \n
+// \r \t \uXXXX are valid. Leaves the cursor after the closing quote.
+// Fast shape: memchr to the candidate closing quote, one linear pass over
+// the span; the per-escape state machine only runs from the first
+// backslash onward (strings in this schema rarely contain any).
 inline bool skip_string(Cursor& c) {
   ++c.p;  // opening quote
   while (c.p < c.end) {
     const char* q =
         static_cast<const char*>(memchr(c.p, '"', c.end - c.p));
     if (!q) return false;
-    // count preceding backslashes for escape parity
-    int bs = 0;
-    const char* b = q - 1;
-    while (b >= c.p && *b == '\\') {
-      ++bs;
-      --b;
+    const char* s = c.p;
+    for (; s < q; ++s) {
+      unsigned char ch = static_cast<unsigned char>(*s);
+      if (ch < 0x20) return false;
+      if (ch == '\\') break;
     }
-    c.p = q + 1;
-    if ((bs & 1) == 0) return true;
+    if (s == q) {  // clean span: q really is the closing quote
+      c.p = q + 1;
+      return true;
+    }
+    // escape at s: validate it, then rescan from after it (the escaped
+    // char may itself be the quote memchr found)
+    if (s + 1 >= c.end) return false;
+    char e = s[1];
+    if (e == '"' || e == '\\' || e == '/' || e == 'b' || e == 'f' ||
+        e == 'n' || e == 'r' || e == 't') {
+      c.p = s + 2;
+      continue;
+    }
+    if (e == 'u') {
+      if (s + 6 > c.end || !ishex(s[2]) || !ishex(s[3]) || !ishex(s[4]) ||
+          !ishex(s[5]))
+        return false;
+      c.p = s + 6;
+      continue;
+    }
+    return false;  // invalid escape: json.loads drops the line
   }
   return false;
 }
@@ -352,9 +401,7 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   *validi = 0;
 
   const char* q = p;
-  while (q < line_end &&
-         (*q == ' ' || *q == '\t' || *q == '\r' || *q == '\f' || *q == '\v'))
-    ++q;
+  while (q < line_end && is_edge_ws(*q)) ++q;
   long ll = line_end - q;
   if (ll == 0) return;                                            // blank
   if ((ll == 3 && strncmp(q, "EOS", 3) == 0) ||
@@ -377,6 +424,7 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   int num_cnt = -1;  // -1 = numericalFeatures not seen yet
   int disc_cnt = 0;
   bool disc_seen = false;
+  bool closed = false;  // saw the object's closing '}'
 
   while (ok && c.p < c.end) {
     skip_ws(c);
@@ -384,7 +432,11 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
       ++c.p;
       continue;
     }
-    if (c.p < c.end && *c.p == '}') break;
+    if (c.p < c.end && *c.p == '}') {
+      ++c.p;
+      closed = true;
+      break;
+    }
     if (c.p >= c.end || *c.p != '"') {
       ok = false;
       break;
@@ -467,7 +519,12 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
         break;
     }
   }
-  if (!ok) return;
+  // strict-JSON parity with the Python codec: a truncated object (no
+  // closing '}') or trailing non-whitespace after it is a drop. The tail
+  // may carry anything str.strip() removes (CRLF files, formfeeds, ...).
+  if (!ok || !closed) return;
+  while (c.p < c.end && is_edge_ws(*c.p)) ++c.p;
+  if (c.p < c.end) return;
 
   int pos = num_cnt > 0 ? num_cnt : 0;
   if (disc_c.p) {

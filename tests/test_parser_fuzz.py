@@ -1,0 +1,142 @@
+"""Fuzz the native bulk-ingest parser against the Python codec.
+
+The C++ parser must NEVER crash, and for every line it must either (a)
+produce exactly what `DataInstance.from_json` + `Vectorizer` produce, or
+(b) flag the line for the Python fallback / drop it — the same contract
+`tests/test_packed_path.py` pins on well-formed streams, here pushed
+through mutated/garbage input (truncation, byte flips, spliced structure,
+huge numbers, unicode)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.api.data import FORECASTING, DataInstance
+from omldm_tpu.runtime.fast_ingest import PackedBatcher
+from omldm_tpu.runtime.vectorizer import Vectorizer
+
+
+DIM = 8
+
+
+def reference_rows(block: bytes):
+    """What the pure-Python path produces for a byte block."""
+    vec = Vectorizer(DIM, 0)
+    xs, ys, ops = [], [], []
+    for line in block.split(b"\n"):
+        inst = DataInstance.from_json(line.decode("utf-8", errors="replace"))
+        if inst is None:
+            continue
+        xs.append(vec.vectorize(inst))
+        ys.append(0.0 if inst.target is None else inst.target)
+        ops.append(1 if inst.operation == FORECASTING else 0)
+    if not xs:
+        return (
+            np.zeros((0, DIM), np.float32),
+            np.zeros((0,), np.float32),
+            np.zeros((0,), np.uint8),
+        )
+    return np.stack(xs), np.asarray(ys, np.float32), np.asarray(ops, np.uint8)
+
+
+def packed_rows(block: bytes):
+    b = PackedBatcher(DIM, batch_size=1 << 20)
+    list(b.feed(block))
+    tail = b.flush()
+    if tail is None:
+        return (
+            np.zeros((0, DIM), np.float32),
+            np.zeros((0,), np.float32),
+            np.zeros((0,), np.uint8),
+        )
+    return tail
+
+
+def make_lines(rng, n):
+    """Valid lines + adversarial mutations."""
+    lines = []
+    for i in range(n):
+        kind = rng.randint(0, 10)
+        x = np.round(rng.randn(rng.randint(1, DIM + 1)), 5)
+        base = {"numericalFeatures": list(x), "target": float(i % 2)}
+        if kind == 0:
+            lines.append(json.dumps(base))
+        elif kind == 1:  # forecast record
+            lines.append(json.dumps({"numericalFeatures": list(x),
+                                     "operation": "forecasting"}))
+        elif kind == 2:  # truncate a valid line at a random byte
+            s = json.dumps(base)
+            lines.append(s[: rng.randint(0, len(s))])
+        elif kind == 3:  # flip one byte of a valid line
+            s = bytearray(json.dumps(base).encode())
+            s[rng.randint(0, len(s))] = rng.randint(1, 255)
+            lines.append(s.decode("utf-8", errors="replace"))
+        elif kind == 4:  # huge / extreme numbers
+            lines.append(json.dumps({
+                "numericalFeatures": [1e308, -1e308, 1e-320, 0.0],
+                "target": 12345678901234567890.0,
+            }))
+        elif kind == 5:  # string-typed numerics, nulls
+            lines.append(
+                '{"numericalFeatures": ["1.5", null, 2], "target": "0"}'
+            )
+        elif kind == 6:  # nested garbage / unknown keys
+            lines.append(json.dumps({
+                "numericalFeatures": list(x),
+                "metadata": {"a": [1, {"b": 2}]},
+                "target": 1.0,
+            }))
+        elif kind == 7:  # categorical features (python-fallback route)
+            lines.append(json.dumps({
+                "numericalFeatures": list(x),
+                "categoricalFeatures": ["a", "b"],
+                "target": 0.0,
+            }))
+        elif kind == 8:  # pure garbage
+            raw = bytes(rng.randint(1, 255, size=rng.randint(1, 40)))
+            lines.append(raw.decode("utf-8", errors="replace")
+                         .replace("\n", " "))
+        else:  # EOS markers and blanks
+            lines.append(rng.choice(["EOS", '"EOS"', "", "   "]))
+    # deterministic adversarial grammar cases (strict json.loads drops and
+    # near-misses that must stay keeps), shuffled into the stream
+    lines.extend([
+        '{"numericalFeatures": [.5, 2.0], "target": 1.0}',     # drop
+        '{"numericalFeatures": [1., 2.0], "target": 1.0}',     # drop
+        '{"numericalFeatures": [01.0, 2.0], "target": 1.0}',   # drop
+        '{"numericalFeatures": [+1.5, 2.0], "target": 1.0}',   # drop
+        '{"numericalFeatures": [-0.5, 0.0, 0], "target": 1.0}',  # keep
+        '{"numericalFeatures": [1.0], "k": "a\\qb", "target": 1.0}',  # drop
+        '{"numericalFeatures": [1.0], "k": "a\\u12зb", "target": 1.0}',  # drop
+        '{"numericalFeatures": [1.0], "k": "a\\u12ab\\n", "target": 1.0}',  # keep
+        '{"numericalFeatures": [1.0, 2.0], "target": 1.0}\x0c',  # keep
+        '{"numericalFeatures": [1.0, 2.0], "target": 1.0}\x1d',  # keep
+        '{"numericalFeatures": [1.0, 2.0], "target": 1.0} x',  # drop
+        '{"numericalFeatures": [1.0, 2.0], "target": 1.0',     # drop
+        '{"numericalFeatures": [1e3, 1E+2, 1e-2], "target": 0.0}',  # keep
+        '{"numericalFeatures": [1e, 2.0], "target": 1.0}',     # drop
+    ])
+    rng.shuffle(lines)
+    return lines
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzzed_blocks_match_python_codec(seed):
+    rng = np.random.RandomState(seed)
+    block = ("\n".join(make_lines(rng, 300)) + "\n").encode()
+    px, py, pop = packed_rows(block)
+    rx, ry, rop = reference_rows(block)
+    assert px.shape == rx.shape
+    np.testing.assert_allclose(px, rx, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(py, ry, rtol=1e-6, atol=0)
+    np.testing.assert_array_equal(pop, rop)
+
+
+def test_binary_garbage_never_crashes():
+    rng = np.random.RandomState(99)
+    blob = bytes(rng.randint(0, 256, size=100_000, dtype=np.uint8).data)
+    x, y, op = packed_rows(blob)  # must not raise
+    # and whatever it kept, the python codec would have kept too
+    rx, _, _ = reference_rows(blob)
+    assert x.shape == rx.shape
