@@ -20,7 +20,6 @@ split attempts per leaf, default 200), ``maxDepth`` (default 20).
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import numpy as np
 

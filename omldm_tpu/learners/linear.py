@@ -19,7 +19,7 @@ column (see ``base.append_bias``), keeping predict/update single fused matmuls.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ Data-structure config: ``hiddenLayers`` (list of widths, default [64, 64]),
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ hashed features).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Type
+from typing import Dict, Type
 
 from omldm_tpu.api.requests import LearnerSpec
 from omldm_tpu.learners.base import Learner

@@ -23,7 +23,7 @@ this is the framework's long-context scope, designed TPU-first.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ sharded over ``axis_name``; :func:`ring_attention_sharded` wraps a whole
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

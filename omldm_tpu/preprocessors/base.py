@@ -15,7 +15,7 @@ online semantics of fitting one record at a time.
 
 from __future__ import annotations
 
-from typing import Any, Mapping, Optional, Tuple
+from typing import Any, Mapping, Optional
 
 import jax.numpy as jnp
 

@@ -9,7 +9,6 @@ available (gated import — no broker needed for tests/benchmarks).
 
 from __future__ import annotations
 
-import itertools
 import json
 from typing import Any, Iterable, Iterator, Sequence, Tuple
 

@@ -17,7 +17,7 @@ this image, hence the gate.
 from __future__ import annotations
 
 import json
-from typing import Any, Callable, Iterator, Mapping, Optional, Tuple
+from typing import Any, Iterator, Mapping, Optional, Tuple
 
 from omldm_tpu.runtime.job import (
     FORECASTING_STREAM,

@@ -30,7 +30,6 @@ from omldm_tpu.config import JobConfig
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
 from omldm_tpu.runtime.databuffers import DataSet
-from omldm_tpu.runtime.messages import OP_PUSH
 from omldm_tpu.runtime.vectorizer import (
     MicroBatcher,
     SparseMicroBatcher,
