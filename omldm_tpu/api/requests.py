@@ -109,6 +109,15 @@ class TrainingConfiguration:
         if not obj:
             return cls()
         known = {"protocol", "HubParallelism", "hubParallelism", "miniBatchSize", "perRecord"}
+        extra = {k: v for k, v in obj.items() if k not in known}
+        # knobs may arrive flat (the wire shape: unknown keys ARE the extra
+        # map) or under an explicit "extra" object (the dataclass field
+        # name, natural for programmatic construction via to_dict/asdict
+        # round trips) — merge the nested form instead of burying it at
+        # extra["extra"] where every lookup would miss it
+        nested = extra.pop("extra", None)
+        if isinstance(nested, Mapping):
+            extra = {**nested, **extra}
         return cls(
             protocol=obj.get("protocol", "Asynchronous"),
             hub_parallelism=int(
@@ -116,7 +125,7 @@ class TrainingConfiguration:
             ),
             mini_batch_size=obj.get("miniBatchSize"),
             per_record=bool(obj.get("perRecord", False)),
-            extra={k: v for k, v in obj.items() if k not in known},
+            extra=extra,
         )
 
     def to_dict(self) -> dict:

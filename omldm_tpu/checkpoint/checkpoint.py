@@ -187,8 +187,7 @@ class CheckpointManager:
             "offset": job.events_processed,
             "source_position": copy.deepcopy(job.source_position),
             "rr": job._rr,
-            "backlog": list(job._backlog),
-            "backlog_rows": job._backlog_rows,
+            "backlog": list(job._backlog._entries),
             "pending_creates": [r.to_dict() for r in job._pending_creates],
             "time": time.time(),
         }
@@ -224,9 +223,42 @@ class CheckpointManager:
 
     @staticmethod
     def _batcher_contents(batcher) -> List[tuple]:
+        if hasattr(batcher, "_idx"):  # SparseMicroBatcher: padded-COO rows
+            return [
+                (
+                    batcher._idx[i].copy(),
+                    batcher._val[i].copy(),
+                    float(batcher._y[i]),
+                )
+                for i in range(len(batcher))
+            ]
         return [
             (batcher._x[i].copy(), float(batcher._y[i])) for i in range(len(batcher))
         ]
+
+    @staticmethod
+    def _refeed_pending(net, pending) -> None:
+        """Re-add snapshotted pending rows to a net's batcher. Shapes:
+        (idx, val, y) sparse batcher rows; ((idx, val), y) sparse
+        holdout-evicted points; (x, y) dense."""
+        for row in pending:
+            if len(row) == 3:
+                net.batcher.add(
+                    np.asarray(row[0], np.int32),
+                    np.asarray(row[1], np.float32),
+                    float(row[2]),
+                )
+            elif isinstance(row[0], tuple):
+                (idx, val), y = row
+                net.batcher.add(
+                    np.asarray(idx, np.int32),
+                    np.asarray(val, np.float32),
+                    float(y),
+                )
+            else:
+                net.batcher.add(np.asarray(row[0], np.float32), float(row[1]))
+            if net.batcher.full:
+                net.flush_batch()
 
     def maybe_save(self, job, now: Optional[float] = None) -> Optional[str]:
         """Periodic checkpointing at ``check_interval_ms`` (the reference's
@@ -281,10 +313,8 @@ class CheckpointManager:
         job.events_processed = snapshot.get("offset", 0)
         job.source_position = snapshot.get("source_position")
         job._rr = snapshot.get("rr", 0)
-        import collections as _collections
-
-        job._backlog = _collections.deque(snapshot.get("backlog", ()))
-        job._backlog_rows = snapshot.get("backlog_rows", len(job._backlog))
+        for entry in snapshot.get("backlog", ()):
+            job._backlog.append(entry)
         job._pending_creates = [
             Request.from_dict(d) for d in snapshot.get("pending_creates", ())
         ]
@@ -435,19 +465,14 @@ class CheckpointManager:
             evicted = net.test_set.append((x, y))
             if evicted is not None:
                 all_pending.append(evicted)
-        for i, (x, y) in enumerate(all_pending):
+        for i, row in enumerate(all_pending):
             net = new_spokes[i % len(new_spokes)].nets[net_id]
-            net.batcher.add(np.asarray(x, np.float32), float(y))
-            if net.batcher.full:
-                net.flush_batch()
+            self._refeed_pending(net, [row])
 
-    @staticmethod
-    def _load_net_state(net, sv: dict) -> None:
+    @classmethod
+    def _load_net_state(cls, net, sv: dict) -> None:
         _pipeline_load(net.pipeline, sv)
         net.holdout_count = sv["holdout_count"]
         for p in sv["test_set"]:
             net.test_set.append(p)
-        for x, y in sv["pending"]:
-            net.batcher.add(np.asarray(x, np.float32), float(y))
-            if net.batcher.full:
-                net.flush_batch()
+        cls._refeed_pending(net, sv["pending"])

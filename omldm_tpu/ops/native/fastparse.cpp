@@ -379,16 +379,42 @@ inline bool skip_composite(Cursor& c) {
   return false;
 }
 
-// Generic value skip for keys we don't extract.
-inline bool skip_value(Cursor& c) {
+// Strictly validate a value we do not extract (json.loads parity).
+// Returns 1 valid-and-consumed, 0 invalid, 2 composite: bracket-matched
+// and strings validated, but contents not fully validated — the caller
+// defers such lines to the Python codec, which decides exactly.
+inline int check_value(Cursor& c) {
   skip_ws(c);
-  if (c.p >= c.end) return false;
+  if (c.p >= c.end) return 0;
   char ch = *c.p;
-  if (ch == '"') return skip_string(c);
-  if (ch == '[' || ch == '{') return skip_composite(c);
-  // number / true / false / null: scan to the next separator
-  while (c.p < c.end && *c.p != ',' && *c.p != '}') ++c.p;
-  return true;
+  if (ch == '"') return skip_string(c) ? 1 : 0;
+  if (ch == '[' || ch == '{') return skip_composite(c) ? 2 : 0;
+  if (ch == 't') {
+    if (c.end - c.p >= 4 && strncmp(c.p, "true", 4) == 0) {
+      c.p += 4;
+      return 1;
+    }
+    return 0;
+  }
+  if (ch == 'f') {
+    if (c.end - c.p >= 5 && strncmp(c.p, "false", 5) == 0) {
+      c.p += 5;
+      return 1;
+    }
+    return 0;
+  }
+  if (ch == 'n') {
+    if (c.end - c.p >= 4 && strncmp(c.p, "null", 4) == 0) {
+      c.p += 4;
+      return 1;
+    }
+    return 0;
+  }
+  double v;
+  Cursor t{c.p, c.end};
+  if (!parse_number(t, &v)) return 0;
+  c.p = t.p;
+  return 1;
 }
 
 // Parse one line into output row i (xi zeroed here).
@@ -425,18 +451,30 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
   int disc_cnt = 0;
   bool disc_seen = false;
   bool closed = false;  // saw the object's closing '}'
+  bool first = true;
 
   while (ok && c.p < c.end) {
     skip_ws(c);
-    if (c.p < c.end && (*c.p == ',' )) {
-      ++c.p;
-      continue;
-    }
     if (c.p < c.end && *c.p == '}') {
       ++c.p;
       closed = true;
       break;
     }
+    // strict member separation (json.loads parity): exactly one comma
+    // between members, none before the first or after the last
+    if (!first) {
+      if (c.p >= c.end || *c.p != ',') {
+        ok = false;
+        break;
+      }
+      ++c.p;
+      skip_ws(c);
+      if (c.p < c.end && *c.p == '}') {
+        ok = false;  // trailing comma
+        break;
+      }
+    }
+    first = false;
     if (c.p >= c.end || *c.p != '"') {
       ok = false;
       break;
@@ -489,8 +527,18 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
           }
           disc_cnt = cnt;
         } else {
+          // deferred array: bracket-matched here, strictly parsed after
+          // the walk by parse_num_array (non-array values fail there,
+          // matching the codec's element-coercion drop)
           disc_c.p = c.p;
-          if (!skip_value(c)) ok = false;
+          if (c.p < c.end && *c.p == '[') {
+            if (!skip_composite(c)) ok = false;
+          } else {
+            int r = check_value(c);
+            if (r == 0) ok = false;
+            // a valid non-array value fails parse_num_array later: drop,
+            // same as the codec's per-element float() coercion
+          }
         }
         break;
       case KEY_TARGET: {
@@ -498,25 +546,62 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
         if (parse_number(t, &target)) {
           have_target = true;
           c.p = t.p;
+        } else if (c.end - c.p >= 4 && strncmp(c.p, "null", 4) == 0) {
+          // explicit null: the codec treats it as absent (last key wins)
+          have_target = false;
+          target = 0.0;
+          c.p += 4;
         } else {
-          ok = false;  // non-numeric target: Jackson-parity drop
+          // string/boolean/other: the codec's float() coercion decides
+          // (float("0") keeps, float("x") drops) — defer to Python
+          *validi = 2;
+          return;
         }
         break;
       }
       case KEY_OPERATION: {
         have_op = true;
-        if (c.p + 9 <= line_end && strncmp(c.p, "\"forecast", 9) == 0) {
-          op_val = 1;
-        } else if (c.p + 9 <= line_end &&
-                   strncmp(c.p, "\"training", 9) == 0) {
-          op_val = 0;
+        op_val = -1;  // duplicate keys: last one wins, like the codec
+        if (c.p < c.end && *c.p == '"') {
+          const char* vs = c.p + 1;
+          if (!skip_string(c)) {
+            ok = false;
+            break;
+          }
+          const char* ve = c.p - 1;
+          long vl = ve - vs;
+          if (memchr(vs, '\\', vl) != nullptr) {
+            *validi = 2;  // escaped spelling: let Python decode+compare
+            return;
+          }
+          // EXACT match (is_valid drops any other operation string)
+          if (vl == 11 && strncmp(vs, "forecasting", 11) == 0) {
+            op_val = 1;
+          } else if (vl == 8 && strncmp(vs, "training", 8) == 0) {
+            op_val = 0;
+          }
+        } else {
+          int r = check_value(c);
+          if (r == 0) {
+            ok = false;
+          } else if (r == 2) {
+            *validi = 2;
+            return;
+          }
+          // valid non-string operation: op_val stays -1 -> dropped below
         }
-        if (!skip_value(c)) ok = false;
         break;
       }
-      case KEY_UNKNOWN:
-        if (!skip_value(c)) ok = false;
+      case KEY_UNKNOWN: {
+        int r = check_value(c);
+        if (r == 0) {
+          ok = false;
+        } else if (r == 2) {
+          *validi = 2;  // composite under an unknown key: Python decides
+          return;
+        }
         break;
+      }
     }
   }
   // strict-JSON parity with the Python codec: a truncated object (no

@@ -15,11 +15,10 @@ Kafka sources, with the same termination protocol driven by a silence timer.
 
 from __future__ import annotations
 
-import collections
 import copy
 import dataclasses
 import time
-from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from omldm_tpu.api.data import FORECASTING, TRAINING, DataInstance, Prediction
 from omldm_tpu.api.requests import Request, RequestType
@@ -87,8 +86,12 @@ class StreamJob:
         # it, a stream whose records precede the Create request would never
         # reach an SPMD-engine pipeline (bridges don't exist yet when the
         # rows flow) and would train only on the host plane's spoke buffers.
-        self._backlog: Deque[tuple] = collections.deque()
-        self._backlog_rows = 0
+        # Backed by the spoke's row-accounted keep-newest buffer; entries
+        # are ("inst", DataInstance) or ("__packed__", (x, y, op), None,
+        # None) so packed blocks trim by row count.
+        from omldm_tpu.runtime.spoke import _PauseBuffer
+
+        self._backlog = _PauseBuffer(PRE_CREATE_BACKLOG_CAP)
         # stream position: events consumed so far. Checkpoints record it so
         # a supervisor can resume a replayable source from the exact event
         # the snapshot covers (the role of Flink's source offsets in a
@@ -232,12 +235,12 @@ class StreamJob:
 
     def _infer_dim_from_buffers(self, request: Request) -> Optional[int]:
         hash_dims = int(request.training_configuration.extra.get("hashDims", 0))
-        if self._backlog:  # peek the oldest pre-create entry
-            kind, *payload = self._backlog[0]
-            if kind == "inst":
-                return Vectorizer.infer_dim(payload[0], hash_dims)
+        head = self._backlog.peek()  # oldest pre-create entry
+        if head is not None:
+            if head[0] == "inst":
+                return Vectorizer.infer_dim(head[1], hash_dims)
             # packed rows already include any hashed-categorical region
-            return int(payload[0].shape[1])
+            return int(head[1][0].shape[1])
         for spoke in self.spokes:
             for inst in spoke.record_buffer:
                 return Vectorizer.infer_dim(inst, hash_dims)
@@ -246,43 +249,12 @@ class StreamJob:
                 return packed_dim
         return None
 
-    def _push_backlog(self, entry: tuple, rows: int) -> None:
-        """Append, then trim the OLDEST rows down to the cap — partial
-        trims on packed entries (same keep-newest semantics as the spoke's
-        packed buffer), so an oversized batch keeps its newest cap rows
-        instead of being dropped whole."""
-        self._backlog.append(entry)
-        self._backlog_rows += rows
-        while self._backlog and self._backlog_rows > PRE_CREATE_BACKLOG_CAP:
-            excess = self._backlog_rows - PRE_CREATE_BACKLOG_CAP
-            kind, *payload = self._backlog[0]
-            if kind == "inst":
-                self._backlog.popleft()
-                self._backlog_rows -= 1
-                continue
-            x, y, op = payload
-            n = int(x.shape[0])
-            if n <= excess:
-                self._backlog.popleft()
-                self._backlog_rows -= n
-            else:
-                # copy: a slice view would pin the whole untrimmed batch
-                self._backlog[0] = (
-                    "packed", x[excess:].copy(), y[excess:].copy(),
-                    op[excess:].copy(),
-                )
-                self._backlog_rows -= excess
-
     def _replay_backlog(self) -> None:
-        if not self._backlog:
-            return
-        backlog, self._backlog = self._backlog, collections.deque()
-        self._backlog_rows = 0
-        for kind, *payload in backlog:
-            if kind == "inst":
-                self._handle_data(payload[0])
+        for entry in self._backlog.drain():
+            if entry[0] == "inst":
+                self._handle_data(entry[1])
             else:
-                self.process_packed_batch(*payload)
+                self.process_packed_batch(*entry[1])
 
     def _request_dim(self, request: Request) -> Optional[int]:
         """Feature dim from the request's dataStructure (nFeatures), else None
@@ -431,7 +403,7 @@ class StreamJob:
                 self._deploy(request, dim)
         if not self._dims:
             # nothing deployed yet: hold for replay on the first deploy
-            self._push_backlog(("inst", inst), 1)
+            self._backlog.append(("inst", inst))
             return
         spoke = self.spokes[self._rr % len(self.spokes)]
         self._rr += 1
@@ -458,7 +430,7 @@ class StreamJob:
             for request in pending:
                 self._deploy(request, int(x.shape[1]))
         if not self._dims:
-            self._push_backlog(("packed", x, y, op), n)
+            self._backlog.append(("__packed__", (x, y, op), None, None))
             return
         p = len(self.spokes)
         for w in range(p):

@@ -183,10 +183,34 @@ def connect_kafka(
             consumer_timeout_ms=poll_timeout_ms,
         )
         # union of the subscribed topics' partitions: a topic that never
-        # delivered a record before the snapshot must still be consumed
+        # delivered a record before the snapshot must still be consumed.
+        # partitions_for_topic can transiently return None on a fresh
+        # client (metadata not fetched yet) — retry before falling back
+        # to the snapshot-recorded partitions + partition 0, and say so:
+        # silently narrowing a multi-partition topic would lose data
+        import time as _time
+
         assigned = []
         for topic in topic_map:
-            parts = consumer.partitions_for_topic(topic) or {0}
+            parts = None
+            for attempt in range(5):
+                parts = consumer.partitions_for_topic(topic)
+                if parts:
+                    break
+                _time.sleep(0.2 * attempt)
+            if not parts:
+                parts = {
+                    p for (t, p) in position if t == topic
+                } | {0}
+                import sys as _sys
+
+                print(
+                    f"warning: no partition metadata for topic {topic!r} "
+                    f"after retries; assigning {sorted(parts)} (snapshot "
+                    "partitions + 0) — records on other partitions will "
+                    "not be consumed",
+                    file=_sys.stderr,
+                )
             assigned.extend(TopicPartition(topic, p) for p in parts)
         for (t, p) in position:
             if TopicPartition(t, p) not in assigned:

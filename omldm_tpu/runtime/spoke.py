@@ -43,11 +43,14 @@ PREDICT_BATCH = 16
 
 
 class _PauseBuffer:
-    """Bounded ROW-accounted hold buffer for records arriving while a net is
-    paused (cooperative toggle). Beyond the cap the OLDEST rows drop —
-    the same keep-newest eviction as every other bounded buffer here
-    (SpokeLogic.scala:31-35); packed blocks are accounted and trimmed by
-    their row counts, not as single entries."""
+    """Bounded ROW-accounted hold buffer: records held while a net is
+    paused (cooperative toggle), the spoke's pre-creation packed buffer,
+    and the job-level pre-create backlog all share this one trim
+    implementation. Beyond the cap the OLDEST rows drop — the same
+    keep-newest eviction as every other bounded buffer here
+    (SpokeLogic.scala:31-35); packed blocks (entry[0] == "__packed__")
+    are accounted and trimmed by their row counts, not as single
+    entries; any other entry counts as one row."""
 
     def __init__(self, cap: int):
         self.cap = cap

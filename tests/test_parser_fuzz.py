@@ -116,6 +116,27 @@ def make_lines(rng, n):
         '{"numericalFeatures": [1.0, 2.0], "target": 1.0',     # drop
         '{"numericalFeatures": [1e3, 1E+2, 1e-2], "target": 0.0}',  # keep
         '{"numericalFeatures": [1e, 2.0], "target": 1.0}',     # drop
+        # object-level grammar (comma discipline)
+        '{"numericalFeatures": [1.0, 2.0] "target": 1.0}',     # drop
+        '{"numericalFeatures": [1.0], "target": 1.0,}',        # drop
+        '{,"numericalFeatures": [1.0]}',                       # drop
+        '{"numericalFeatures": [1.0], , "target": 1.0}',       # drop
+        # unknown-key values must be valid JSON; composites defer to Python
+        '{"numericalFeatures": [1.0], "zz": blah garbage, "target": 1.0}',
+        '{"numericalFeatures": [1.0], "zz": true, "id": null, "w": false}',
+        '{"numericalFeatures": [1.0], "zz": {"n": [1, "x"]}, "target": 1.0}',
+        # operation: exact spelling, last key wins, non-strings drop
+        '{"numericalFeatures": [1.0], "operation": "forecaster"}',  # drop
+        '{"numericalFeatures": [1.0], "operation": "forecasting"}',  # keep
+        '{"numericalFeatures": [1.0], "operation": "training", '
+        '"operation": "bogus"}',                               # drop
+        '{"numericalFeatures": [1.0], "operation": 5}',        # drop
+        # target coercion corners (the codec's float() decides)
+        '{"numericalFeatures": [1.0], "target": null}',        # keep
+        '{"numericalFeatures": [1.0], "target": "0"}',         # keep!
+        '{"numericalFeatures": [1.0], "target": "x"}',         # drop
+        '{"numericalFeatures": [1.0], "target": true}',        # keep!
+        '{"numericalFeatures": [1.0], "target": 1.0, "target": null}',
     ])
     rng.shuffle(lines)
     return lines

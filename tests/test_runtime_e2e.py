@@ -339,7 +339,7 @@ class TestPreCreateBacklog:
 
         for _ in range(3):  # 180k rows > cap
             job.process_event(PACKED_STREAM, (x, y, op))
-        assert job._backlog_rows == PRE_CREATE_BACKLOG_CAP
+        assert len(job._backlog) == PRE_CREATE_BACKLOG_CAP
 
     def test_backlog_single_oversized_batch_keeps_newest(self):
         from omldm_tpu.runtime.job import (
@@ -354,10 +354,10 @@ class TestPreCreateBacklog:
         y = np.zeros((n,), np.float32)
         op = np.zeros((n,), np.uint8)
         job.process_event(PACKED_STREAM, (x, y, op))
-        assert job._backlog_rows == PRE_CREATE_BACKLOG_CAP
-        kind, bx, _, _ = job._backlog[0]
+        assert len(job._backlog) == PRE_CREATE_BACKLOG_CAP
+        kind, (bx, _, _), _, _ = job._backlog.peek()
         # newest rows kept (partial trim, not a whole-entry drop)
-        assert kind == "packed" and float(bx[-1, 0]) == float(n - 1)
+        assert kind == "__packed__" and float(bx[-1, 0]) == float(n - 1)
         assert float(bx[0, 0]) == 5000.0
 
 
