@@ -412,9 +412,16 @@ inline int check_value(Cursor& c) {
   }
   double v;
   Cursor t{c.p, c.end};
-  if (!parse_number(t, &v)) return 0;
-  c.p = t.p;
-  return 1;
+  if (parse_number(t, &v)) {
+    c.p = t.p;
+    return 1;
+  }
+  // starts like a number but failed the strict parse: overflow to inf
+  // (json.loads keeps it — and is_valid never inspects ignored keys) or
+  // grammar junk (json.loads drops). Either way the Python codec is the
+  // authority: defer instead of dropping a possibly-valid record.
+  if (ch == '-' || (ch >= '0' && ch <= '9')) return 2;
+  return 0;
 }
 
 // Parse one line into output row i (xi zeroed here).

@@ -28,7 +28,7 @@ from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime.control import PipelineManager
 from omldm_tpu.runtime.hub import HubManager
 from omldm_tpu.runtime.responses import ResponseMerger
-from omldm_tpu.runtime.spoke import Spoke
+from omldm_tpu.runtime.spoke import Spoke, _PauseBuffer
 from omldm_tpu.runtime.stats import StatisticsCollector
 from omldm_tpu.runtime.vectorizer import Vectorizer
 
@@ -89,8 +89,6 @@ class StreamJob:
         # Backed by the spoke's row-accounted keep-newest buffer; entries
         # are ("inst", DataInstance) or ("__packed__", (x, y, op), None,
         # None) so packed blocks trim by row count.
-        from omldm_tpu.runtime.spoke import _PauseBuffer
-
         self._backlog = _PauseBuffer(PRE_CREATE_BACKLOG_CAP)
         # stream position: events consumed so far. Checkpoints record it so
         # a supervisor can resume a replayable source from the exact event

@@ -194,10 +194,11 @@ def connect_kafka(
         for topic in topic_map:
             parts = None
             for attempt in range(5):
+                if attempt:  # back off BEFORE each retry, not after the last
+                    _time.sleep(0.2 * attempt)
                 parts = consumer.partitions_for_topic(topic)
                 if parts:
                     break
-                _time.sleep(0.2 * attempt)
             if not parts:
                 parts = {
                     p for (t, p) in position if t == topic

@@ -18,7 +18,8 @@ fixed-width padded predict batch.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import collections
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -54,7 +55,9 @@ class _PauseBuffer:
 
     def __init__(self, cap: int):
         self.cap = cap
-        self._entries: List[tuple] = []
+        # deque: the trim pops from the FRONT on every over-cap append —
+        # a list's pop(0) would make sustained over-cap ingest quadratic
+        self._entries: Deque[tuple] = collections.deque()
         self._rows = 0
 
     def __len__(self) -> int:
@@ -78,7 +81,7 @@ class _PauseBuffer:
             head = self._entries[0]
             n = self._entry_rows(head)
             if n <= excess:
-                self._entries.pop(0)
+                self._entries.popleft()
                 self._rows -= n
             else:
                 px, py, pop = head[1]
@@ -94,7 +97,7 @@ class _PauseBuffer:
         return self._entries[0] if self._entries else None
 
     def drain(self) -> List[tuple]:
-        entries, self._entries = self._entries, []
+        entries, self._entries = list(self._entries), collections.deque()
         self._rows = 0
         return entries
 

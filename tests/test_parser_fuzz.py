@@ -125,6 +125,11 @@ def make_lines(rng, n):
         '{"numericalFeatures": [1.0], "zz": blah garbage, "target": 1.0}',
         '{"numericalFeatures": [1.0], "zz": true, "id": null, "w": false}',
         '{"numericalFeatures": [1.0], "zz": {"n": [1, "x"]}, "target": 1.0}',
+        # overflow under an ignored key: json.loads -> inf, record KEPT
+        '{"numericalFeatures": [1.0], "zz": 1e999, "target": 1.0}',
+        '{"numericalFeatures": [1.0], "id": 1e1234567, "target": 1.0}',
+        # overflow in FEATURES: is_valid rejects non-finite -> drop
+        '{"numericalFeatures": [1e999], "target": 1.0}',
         # operation: exact spelling, last key wins, non-strings drop
         '{"numericalFeatures": [1.0], "operation": "forecaster"}',  # drop
         '{"numericalFeatures": [1.0], "operation": "forecasting"}',  # keep
@@ -161,3 +166,83 @@ def test_binary_garbage_never_crashes():
     # and whatever it kept, the python codec would have kept too
     rx, _, _ = reference_rows(blob)
     assert x.shape == rx.shape
+
+
+def test_request_codec_fuzz_never_raises():
+    """Request.from_json mirrors RequestParser.scala:12-17: malformed
+    requests drop silently — no mutation may raise. A full StreamJob must
+    likewise survive a hostile request stream without deploying anything
+    invalid."""
+    from omldm_tpu.api.requests import Request
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    base = {
+        "id": 0,
+        "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+        "trainingConfiguration": {"protocol": "Synchronous"},
+    }
+    rng = np.random.RandomState(7)
+    payloads = []
+    for i in range(400):
+        kind = rng.randint(0, 8)
+        if kind == 0:
+            payloads.append(json.dumps(base))
+        elif kind == 1:  # byte flip
+            s = bytearray(json.dumps(base).encode())
+            s[rng.randint(0, len(s))] = rng.randint(1, 255)
+            payloads.append(s.decode("utf-8", errors="replace"))
+        elif kind == 2:  # truncation
+            s = json.dumps(base)
+            payloads.append(s[: rng.randint(0, len(s))])
+        elif kind == 3:  # wrong types
+            payloads.append(json.dumps({
+                "id": "zero", "request": 5, "learner": "PA",
+            }))
+        elif kind == 4:  # unknown request kinds / missing fields
+            payloads.append(json.dumps({"id": i, "request": "Explode"}))
+        elif kind == 5:  # deep nesting
+            payloads.append(json.dumps({
+                "id": i % 4, "request": "Query",
+                "requestId": i,
+                "learner": {"name": "PA", "dataStructure": {"a": [[[1]]]}},
+            }))
+        elif kind == 6:  # non-object JSON
+            payloads.append(rng.choice(["[]", "5", '"x"', "null", "true"]))
+        else:  # binary garbage
+            raw = bytes(rng.randint(1, 255, size=rng.randint(1, 50)))
+            payloads.append(raw.decode("utf-8", errors="replace"))
+    for text in payloads:
+        Request.from_json(text)  # must not raise
+    job = StreamJob(JobConfig(parallelism=1))
+    for text in payloads:
+        job.process_event(REQUEST_STREAM, text)  # must not raise
+    # nothing hostile deployed except well-formed Creates (id 0)
+    assert set(job.pipeline_manager.live_pipelines) <= {0}
+
+
+def test_cli_backend_fallback(monkeypatch):
+    """--ensure-backend falls back to CPU when the accelerator cannot
+    initialize instead of crashing the job (__main__._ensure_backend)."""
+    import jax
+
+    from omldm_tpu.__main__ import _ensure_backend
+
+    calls = {"n": 0, "updates": []}
+
+    def fake_devices():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("tunnel down")
+        return ["cpu0"]
+
+    monkeypatch.setattr(jax, "devices", fake_devices)
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda k, v: calls["updates"].append((k, v)),
+    )
+    _ensure_backend()
+    assert ("jax_platforms", "cpu") in calls["updates"]
+    assert calls["n"] == 2
