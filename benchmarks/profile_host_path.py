@@ -1,0 +1,117 @@
+"""Profile where host-pipeline time goes on the e2e bench path (one core).
+
+Stages measured independently over the same generated stream file:
+  read    : file readinto loop only
+  cparse  : read + C block parse (no postprocess/emit)
+  batches : full iter_file_batches (parse + postprocess + emit)
+  host    : full host pipeline (job.process_packed_batch, device stubbed)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from run_benchmarks import _gen_stream_file, _make_e2e_job
+
+
+def main(n=1_000_000):
+    import tempfile
+
+    dim = 28
+    tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+    tmp.close()
+    n_bytes = _gen_stream_file(tmp.name, n, dim)
+    print(f"stream: {n} records, {n_bytes/1e6:.1f} MB")
+
+    # read only
+    for _ in range(2):
+        buf = bytearray(1 << 22)
+        t0 = time.perf_counter()
+        with open(tmp.name, "rb") as f:
+            while f.readinto(buf):
+                pass
+        t_read = time.perf_counter() - t0
+    print(f"read    : {t_read:.3f}s  {n/t_read/1e6:.2f} M rec/s")
+
+    # C parse only
+    from omldm_tpu.ops.native import FastParser
+
+    for _ in range(2):
+        p = FastParser(dim, 1)
+        buf = bytearray(1 << 22)
+        carry = 0
+        t0 = time.perf_counter()
+        with open(tmp.name, "rb") as f:
+            while True:
+                k = f.readinto(memoryview(buf)[carry:])
+                if not k:
+                    break
+                end = carry + k
+                cut = buf.rfind(b"\n", 0, end)
+                if cut < 0:
+                    carry = end
+                    continue
+                p.parse_range(buf, 0, cut + 1)
+                carry = end - (cut + 1)
+                if carry:
+                    buf[:carry] = buf[cut + 1 : end]
+        t_cparse = time.perf_counter() - t0
+    print(f"cparse  : {t_cparse:.3f}s  {n/t_cparse/1e6:.2f} M rec/s")
+
+    # full batcher
+    from omldm_tpu.runtime.fast_ingest import iter_file_batches
+
+    for _ in range(2):
+        t0 = time.perf_counter()
+        total = 0
+        for bx, by, bop in iter_file_batches(tmp.name, dim, 32768):
+            total += bx.shape[0]
+        t_batches = time.perf_counter() - t0
+    print(f"batches : {t_batches:.3f}s  {n/t_batches/1e6:.2f} M rec/s ({total})")
+
+    # with prefetch thread
+    from omldm_tpu.runtime.prefetch import prefetch
+
+    for _ in range(2):
+        t0 = time.perf_counter()
+        total = 0
+        for bx, by, bop in prefetch(iter_file_batches(tmp.name, dim, 32768), depth=3):
+            total += bx.shape[0]
+        t_pf = time.perf_counter() - t0
+    print(f"batch+pf: {t_pf:.3f}s  {n/t_pf/1e6:.2f} M rec/s")
+
+    # full host pipeline, device stubbed
+    job_h, bridge_h = _make_e2e_job(dim, 1, 32)
+
+    class _NopTrainer:
+        fitted = 0
+
+        def step_many_dense(self, *a, **k):
+            pass
+
+        def step(self, *a, **k):
+            pass
+
+        def predict(self, x):
+            return np.zeros(x.shape[0])
+
+    bridge_h.trainer = _NopTrainer()
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for batch in prefetch(iter_file_batches(tmp.name, dim, 32768), depth=3):
+            job_h.process_packed_batch(*batch)
+        bridge_h.flush()
+        t_host = time.perf_counter() - t0
+    print(f"host    : {t_host:.3f}s  {n/t_host/1e6:.2f} M rec/s")
+    os.unlink(tmp.name)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)

@@ -177,6 +177,8 @@ def _run(job: StreamJob, flags: Dict[str, str]) -> int:
     elif "events" in flags:
         _run_replay(job, flags, lambda: combined_events(flags["events"]))
     else:
+        if _try_fused_run(job, flags):
+            return 0
 
         def make_events():
             packed = None
@@ -349,6 +351,48 @@ def _run_replay(job: StreamJob, flags: Dict[str, str], make_events) -> None:
         job.run(make_events())
 
 
+def _try_fused_run(job: StreamJob, flags: Dict[str, str]) -> bool:
+    """The fastest file route: requests replayed up front, then the training
+    file consumed by the fused C parse->holdout->stage loop
+    (StreamJob.run_file_fused). Taken only when the per-event loop would
+    have nothing else to schedule — a single SPMD-plane pipeline, a
+    training file as the only data source, no checkpointing (the event loop
+    owns maybe_save), no forecasting/file sinks racing the stream. Falls
+    back to the packed event route otherwise; requests stay processed (the
+    packed route coarsens request/data interleaving the same way)."""
+    if TRAINING_STREAM not in flags:
+        return False
+    if flags.get("fastIngest", "auto") == "false":
+        return False
+    if flags.get("fusedIngest", "auto") == "false":
+        return False
+    if job.checkpoint_manager is not None:
+        return False
+    if int(flags.get("restartAttempts", "0")) > 0:
+        return False  # supervised recovery wraps the event loop, not this
+    if any(
+        t in flags for t in _STREAMS if t not in (TRAINING_STREAM, REQUEST_STREAM)
+    ):
+        return False
+    spec = _stream_spec(flags)
+    if spec is None:
+        return False
+    if REQUEST_STREAM in flags:
+        for stream, line in file_events(flags[REQUEST_STREAM], REQUEST_STREAM):
+            job.process_event(stream, line)
+        # consumed here either way: the fallback event route must not
+        # replay them a second time. The packed fallback still needs the
+        # width the requests pinned, so stash the resolved spec.
+        del flags[REQUEST_STREAM]
+        flags["__streamSpec__"] = f"{spec[0]},{spec[1]}"
+    job.ensure_deployed(spec[0])
+    if job.fused_file_bridge() is None:
+        return False  # requests stay processed; packed route resumes
+    job.run_file_fused(flags[TRAINING_STREAM])
+    job.terminate()
+    return True
+
+
 def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
     """(total feature dim, hash_dims) for the packed ingest path: from the
     first Create/Update request carrying nFeatures, else inferred from the
@@ -358,6 +402,9 @@ def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
     from omldm_tpu.api.requests import Request, RequestType
     from omldm_tpu.runtime.vectorizer import Vectorizer
 
+    if "__streamSpec__" in flags:  # resolved earlier by the fused route
+        dim, hash_dims = flags["__streamSpec__"].split(",")
+        return int(dim), int(hash_dims)
     if REQUEST_STREAM in flags:
         try:
             for _, line in file_events(flags[REQUEST_STREAM], REQUEST_STREAM):

@@ -8,6 +8,10 @@ via ctypes (no pybind11 in this image) and falls back to the pure-Python
 parser when a toolchain is unavailable.
 """
 
-from omldm_tpu.ops.native.loader import FastParser, fast_parser_available
+from omldm_tpu.ops.native.loader import (
+    FastParser,
+    FusedStage,
+    fast_parser_available,
+)
 
-__all__ = ["FastParser", "fast_parser_available"]
+__all__ = ["FastParser", "FusedStage", "fast_parser_available"]

@@ -133,17 +133,19 @@ inline bool is_edge_ws(char ch) {
 inline bool parse_number(Cursor& c, double* out) {
   const char* p = c.p;
   const char* end = c.end;
-  bool neg = false;
-  // strict JSON (json.loads/Jackson parity): a leading '+' is invalid
-  if (p < end && *p == '+') return false;
-  if (p < end && *p == '-') {
-    neg = true;
-    ++p;
-  }
+  if (p >= end) return false;
+  // branchless sign consume: random signs in numeric streams would
+  // mispredict a conditional ++p roughly every other number. A leading
+  // '+' stays invalid (json.loads parity): it fails the digit check below.
+  bool neg = (*p == '-');
+  p += neg;
   // strict JSON grammar: the integer part needs >= 1 digit and no
-  // leading zero — ".5", "-.5", "01" are json.loads drops
+  // leading zero — ".5", "-.5", "01", "+1" are json.loads drops. The
+  // next-byte load is guarded by a (predictable) bounds branch; the digit
+  // compares stay branchless ('0' leads ~half of sub-1 magnitudes).
   if (p >= end || *p < '0' || *p > '9') return false;
-  if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9') return false;
+  char c1 = (p + 1 < end) ? p[1] : '\0';
+  if ((*p == '0') & (c1 >= '0') & (c1 <= '9')) return false;
   uint64_t mant = 0;
   int digits = 0;
   int frac = 0;
@@ -228,12 +230,19 @@ have_mantissa:;
   }
   if (!std::isfinite(v)) return false;
   c.p = p;
-  *out = neg ? -v : v;
+  // branchless sign application (same misprediction argument as above)
+  uint64_t vb;
+  memcpy(&vb, &v, 8);
+  vb ^= static_cast<uint64_t>(neg) << 63;
+  memcpy(out, &vb, 8);
   return true;
 }
 
 // Parse a JSON array of numbers into dst (cap n); *count <- #parsed.
 // Cursor must sit on '['. Non-numeric elements => false (fallback).
+// The element loop is specialized for the dominant separators — "', '"
+// between elements, none around the brackets — with a full skip_ws
+// fallback for any other JSON whitespace arrangement.
 inline bool parse_num_array(Cursor& c, float* dst, int cap, int* count) {
   if (c.p >= c.end || *c.p != '[') return false;
   ++c.p;
@@ -245,14 +254,29 @@ inline bool parse_num_array(Cursor& c, float* dst, int cap, int* count) {
     return true;
   }
   while (c.p < c.end) {
-    skip_ws(c);
     double v;
     if (!parse_number(c, &v)) return false;
     if (n < cap) dst[n] = static_cast<float>(v);
     ++n;
+    if (c.p >= c.end) return false;
+    char ch = *c.p;
+    if (ch == ',') {
+      ++c.p;
+      if (c.p < c.end && *c.p == ' ') ++c.p;
+      if (c.p < c.end && (*c.p == ' ' || *c.p == '\t' || *c.p == '\n' ||
+                          *c.p == '\r'))
+        skip_ws(c);
+      continue;
+    }
+    if (ch == ']') {
+      ++c.p;
+      *count = (n < cap) ? n : cap;
+      return true;
+    }
     skip_ws(c);
     if (c.p < c.end && *c.p == ',') {
       ++c.p;
+      skip_ws(c);
       continue;
     }
     if (c.p < c.end && *c.p == ']') {
@@ -424,11 +448,14 @@ inline int check_value(Cursor& c) {
   return 0;
 }
 
-// Parse one line into output row i (xi zeroed here).
+// Parse one line into output row i. xi is only defined when *validi == 1
+// (features zero-padded to dim); dropped/fallback rows leave xi
+// unspecified — consumers mask them out (valid != 1) or reparse via the
+// Python codec, so the zero-fill is deferred to the success path instead
+// of a 112-byte memset per line.
 inline void parse_one_line(const char* p, const char* line_end, int dim,
                            float* xi, float* yi, unsigned char* opi,
                            unsigned char* validi) {
-  memset(xi, 0, sizeof(float) * dim);
   *yi = 0.0f;
   *opi = 0;
   *validi = 0;
@@ -635,7 +662,13 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
     if (op_val < 0) return;  // unknown operation: drop
     *opi = static_cast<unsigned char>(op_val);
   }
-  *validi = any ? 1 : 0;
+  if (any) {
+    // deferred zero-fill (see above): only the unfilled tail, only on keep
+    int filled = pos + disc_cnt;
+    if (filled < dim)
+      memset(xi + filled, 0, sizeof(float) * static_cast<size_t>(dim - filled));
+    *validi = 1;
+  }
 }
 
 }  // namespace
@@ -658,6 +691,123 @@ int omldm_parse_lines(const char* buf, long len, int dim, int max_records,
   }
   if (bytes_consumed) *bytes_consumed = p - buf;
   return i;
+}
+
+// --- fused parse -> holdout -> stage -------------------------------------
+//
+// The e2e hot loop (SPMDBridge.handle_batch -> _train_rows -> _stage_rows)
+// re-touches every row several times in numpy: batcher copy, holdout
+// split/concatenate, stage memcpy. This entry fuses the whole per-record
+// path (FlinkSpoke.scala:92-107 semantics) into the parse itself: each line
+// is parsed DIRECTLY into its stage slot, the 8-of-10 holdout cycle
+// (counts 8,9 of each 0-9 cycle) runs in place, and ring eviction swaps the
+// evicted row into the very slot the arriving row was parsed into — the
+// evicted point re-enters training at the evicting row's stream position,
+// exact ArrayHoldout.append_many parity. Rare lines (Python-codec fallback,
+// forecasts) return control to the caller so the hot loop stays pure C.
+struct OmldmStageCtx {
+  float* stage_x;       // [stage_cap, row_stride] training stage
+  float* stage_y;       // [stage_cap]
+  long long stage_cap;
+  long long stage_n;
+  float* hold_x;        // [hold_cap, row_stride] holdout ring
+  float* hold_y;        // [hold_cap]
+  long long hold_cap;
+  long long hold_n;
+  long long hold_head;  // oldest element
+  long long holdout_count;  // position in the 0-9 holdout cycle
+  long long row_stride;     // floats per stage/holdout row (>= n_features)
+  int n_features;           // dense parse budget (row_stride - hash_dims)
+  int test_enabled;
+};
+
+namespace {
+
+// Holdout-split one training row already sitting in its stage slot.
+// Returns 1 if the row stays staged (slot consumed), 0 if it moved to the
+// holdout ring (slot free for reuse).
+inline int stage_holdout_slot(OmldmStageCtx* ctx, float* slot, float yv) {
+  long long cyc = ctx->holdout_count % 10;
+  ctx->holdout_count++;
+  if (ctx->test_enabled && cyc >= 8 && ctx->hold_cap > 0) {
+    long long stride = ctx->row_stride;
+    if (ctx->hold_n < ctx->hold_cap) {
+      long long pos = (ctx->hold_head + ctx->hold_n) % ctx->hold_cap;
+      memcpy(ctx->hold_x + pos * stride, slot,
+             sizeof(float) * static_cast<size_t>(stride));
+      ctx->hold_y[pos] = yv;
+      ctx->hold_n++;
+      return 0;
+    }
+    // ring full: swap the oldest row into this slot (it re-enters training
+    // here) and store the arriving row in its place
+    long long pos = ctx->hold_head;
+    float* ring = ctx->hold_x + pos * stride;
+    for (long long i = 0; i < stride; ++i) {
+      float t = ring[i];
+      ring[i] = slot[i];
+      slot[i] = t;
+    }
+    float ty = ctx->hold_y[pos];
+    ctx->hold_y[pos] = yv;
+    yv = ty;
+    ctx->hold_head = (ctx->hold_head + 1) % ctx->hold_cap;
+  }
+  ctx->stage_y[ctx->stage_n] = yv;
+  ctx->stage_n++;
+  return 1;
+}
+
+}  // namespace
+
+// Parse a block of whole JSON lines straight into the staging buffers.
+// Returns:
+//   0  buffer fully consumed
+//   1  stage full (caller launches the device step, resets stage_n, resumes)
+//   2  fallback line (Python codec decides; [*special_off, +*special_len))
+//   3  forecast row (features in fore_x[0..row_stride), target in *fore_y)
+// *bytes_consumed is the resume offset relative to buf in all cases (for
+// 2/3 it points past the special line).
+int omldm_parse_stage(const char* buf, long long len, OmldmStageCtx* ctx,
+                      long long* bytes_consumed, long long* special_off,
+                      long long* special_len, float* fore_x, float* fore_y) {
+  const char* p = buf;
+  const char* bufend = buf + len;
+  const long long stride = ctx->row_stride;
+  const int nfeat = ctx->n_features;
+  while (p < bufend) {
+    if (ctx->stage_n >= ctx->stage_cap) {
+      *bytes_consumed = p - buf;
+      return 1;
+    }
+    const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
+    const char* line_end = nl ? nl : bufend;
+    const char* next = nl ? nl + 1 : bufend;
+    float* slot = ctx->stage_x + ctx->stage_n * stride;
+    float yv;
+    unsigned char opv, validv;
+    parse_one_line(p, line_end, nfeat, slot, &yv, &opv, &validv);
+    if (validv == 1) {
+      if (stride > nfeat)  // zero the hashed-categorical tail (slot reuse)
+        memset(slot + nfeat, 0,
+               sizeof(float) * static_cast<size_t>(stride - nfeat));
+      if (opv == 1) {
+        memcpy(fore_x, slot, sizeof(float) * static_cast<size_t>(stride));
+        *fore_y = yv;
+        *bytes_consumed = next - buf;
+        return 3;
+      }
+      stage_holdout_slot(ctx, slot, yv);
+    } else if (validv == 2) {
+      *special_off = p - buf;
+      *special_len = line_end - p;
+      *bytes_consumed = next - buf;
+      return 2;
+    }
+    p = next;
+  }
+  *bytes_consumed = len;
+  return 0;
 }
 
 int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,

@@ -52,7 +52,35 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.omldm_parse_lines.argtypes = base_argtypes + [consumed_p]
     lib.omldm_parse_lines_mt.restype = ctypes.c_int
     lib.omldm_parse_lines_mt.argtypes = base_argtypes + [ctypes.c_int, consumed_p]
+    ll_p = ctypes.POINTER(ctypes.c_longlong)
+    f_p = ctypes.POINTER(ctypes.c_float)
+    lib.omldm_parse_stage.restype = ctypes.c_int
+    lib.omldm_parse_stage.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.POINTER(StageCtx),
+        ll_p, ll_p, ll_p, f_p, f_p,
+    ]
     return lib
+
+
+class StageCtx(ctypes.Structure):
+    """Mirror of OmldmStageCtx (fastparse.cpp): the fused
+    parse->holdout->stage loop's view of the caller's staging buffers."""
+
+    _fields_ = [
+        ("stage_x", ctypes.POINTER(ctypes.c_float)),
+        ("stage_y", ctypes.POINTER(ctypes.c_float)),
+        ("stage_cap", ctypes.c_longlong),
+        ("stage_n", ctypes.c_longlong),
+        ("hold_x", ctypes.POINTER(ctypes.c_float)),
+        ("hold_y", ctypes.POINTER(ctypes.c_float)),
+        ("hold_cap", ctypes.c_longlong),
+        ("hold_n", ctypes.c_longlong),
+        ("hold_head", ctypes.c_longlong),
+        ("holdout_count", ctypes.c_longlong),
+        ("row_stride", ctypes.c_longlong),
+        ("n_features", ctypes.c_int),
+        ("test_enabled", ctypes.c_int),
+    ]
 
 
 def _get_lib() -> Optional[ctypes.CDLL]:
@@ -66,6 +94,83 @@ def _get_lib() -> Optional[ctypes.CDLL]:
 
 def fast_parser_available() -> bool:
     return _get_lib() is not None
+
+
+class FusedStage:
+    """Driver for the fused C parse->holdout->stage loop (omldm_parse_stage).
+
+    Owns the ctypes ``StageCtx`` describing the caller's staging/holdout
+    numpy buffers; the caller syncs the mutable cursors (stage_n, holdout
+    ring state, holdout cycle counter) in before each C call and out after,
+    so Python-side code (device launches, fallback rows) and the C loop can
+    interleave on the same state."""
+
+    RC_DONE = 0       # buffer fully consumed
+    RC_STAGE_FULL = 1  # caller launches the staged step and resumes
+    RC_FALLBACK = 2   # line needs the Python codec
+    RC_FORECAST = 3   # forecast row parsed into fore_x / fore_y
+
+    def __init__(
+        self,
+        stage_x: np.ndarray,
+        stage_y: np.ndarray,
+        hold_x: np.ndarray,
+        hold_y: np.ndarray,
+        n_features: int,
+        test_enabled: bool,
+    ):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native fast parser unavailable (g++ build failed)")
+        self._lib = lib
+        for a in (stage_x, stage_y, hold_x, hold_y):
+            if a.dtype != np.float32 or not a.flags.c_contiguous:
+                raise ValueError("fused stage buffers must be C-contiguous float32")
+        if stage_x.shape[1] != hold_x.shape[1]:
+            raise ValueError("stage/holdout row widths differ")
+        # keep the arrays alive for the ctx's pointer lifetime
+        self._arrays = (stage_x, stage_y, hold_x, hold_y)
+        f_p = ctypes.POINTER(ctypes.c_float)
+        self.ctx = StageCtx(
+            stage_x=stage_x.ctypes.data_as(f_p),
+            stage_y=stage_y.ctypes.data_as(f_p),
+            stage_cap=stage_x.shape[0],
+            stage_n=0,
+            hold_x=hold_x.ctypes.data_as(f_p),
+            hold_y=hold_y.ctypes.data_as(f_p),
+            hold_cap=hold_x.shape[0],
+            hold_n=0,
+            hold_head=0,
+            holdout_count=0,
+            row_stride=stage_x.shape[1],
+            n_features=n_features,
+            test_enabled=1 if test_enabled else 0,
+        )
+        self._fore_x = np.zeros((stage_x.shape[1],), np.float32)
+        self._fore_y = ctypes.c_float(0.0)
+
+    def parse_stage(self, buf: bytearray, start: int, stop: int):
+        """One C call over ``buf[start:stop]`` (whole JSON lines only).
+        Returns (rc, consumed, special_off, special_len); offsets are
+        relative to ``start``."""
+        base = ctypes.addressof((ctypes.c_char * len(buf)).from_buffer(buf))
+        consumed = ctypes.c_longlong(0)
+        soff = ctypes.c_longlong(0)
+        slen = ctypes.c_longlong(0)
+        rc = self._lib.omldm_parse_stage(
+            base + start,
+            stop - start,
+            ctypes.byref(self.ctx),
+            ctypes.byref(consumed),
+            ctypes.byref(soff),
+            ctypes.byref(slen),
+            self._fore_x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(self._fore_y),
+        )
+        return rc, consumed.value, soff.value, slen.value
+
+    def forecast_row(self):
+        return self._fore_x, float(self._fore_y.value)
 
 
 class FastParser:
@@ -93,9 +198,9 @@ class FastParser:
         """One C call over ``length`` bytes at ``addr``, arrays sized for
         n_cap lines. Returns (x, y, op, valid) sliced to the consumed rows
         + the bytes consumed."""
-        # np.empty: the C parser writes every row it consumes (xi is memset
-        # per line; y/op/valid are unconditionally stored), and the caller
-        # slices to the consumed count
+        # np.empty: y/op/valid are unconditionally stored per consumed
+        # line; x rows are only defined where valid == 1 (callers mask or
+        # reparse the rest), and the caller slices to the consumed count
         x = np.empty((n_cap, self.dim), np.float32)
         y = np.empty((n_cap,), np.float32)
         op = np.empty((n_cap,), np.uint8)

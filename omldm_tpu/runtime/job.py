@@ -441,6 +441,40 @@ class StreamJob:
         for bridge in self.spmd_bridges.values():
             bridge.handle_batch(x, y, op)
 
+    def ensure_deployed(self, dim: int) -> None:
+        """Deploy any Create requests still waiting on a feature width —
+        the fused file route knows the width up front (CLI flags / schema)
+        instead of from the first data record."""
+        if self._pending_creates:
+            pending, self._pending_creates = self._pending_creates, []
+            for request in pending:
+                self._deploy(request, dim)
+
+    def fused_file_bridge(self):
+        """The single SPMD bridge qualifying for fused C file ingest, or
+        None. Fused ingest bypasses the per-event loop, so it is only taken
+        when that loop would have nothing else to do: exactly one deployed
+        pipeline, on the SPMD plane, with no host-plane nets and no pending
+        work."""
+        if self._pending_creates or self._backlog or self.stats.terminated:
+            return None
+        if len(self.spmd_bridges) != 1:
+            return None
+        if any(net_id not in self.spmd_bridges for net_id in self._dims):
+            return None  # host-plane pipelines also consume the stream
+        bridge = next(iter(self.spmd_bridges.values()))
+        return bridge if bridge.supports_fused_ingest() else None
+
+    def run_file_fused(self, path: str) -> bool:
+        """Consume a JSON-lines training file through the fused C ingest
+        (SPMDBridge.ingest_file). Returns False when the job does not
+        qualify — callers fall back to the packed event route."""
+        bridge = self.fused_file_bridge()
+        if bridge is None:
+            return False
+        bridge.ingest_file(path, on_chunk=self.stats.mark_activity)
+        return True
+
     # --- run loops ---
 
     def run(

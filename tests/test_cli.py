@@ -75,6 +75,42 @@ class TestFileReplayJob:
         assert stats["pipeline"] == 0
         assert stats["fitted"] > 400
 
+    def test_fused_route_matches_packed(self, tmp_path):
+        """An SPMD-plane file job takes the fused C ingest route and lands
+        the same fitted count / score as the packed event route."""
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "requests.jsonl"
+        _write_stream(str(train))
+        create = json.loads(json.dumps(CREATE))
+        create["trainingConfiguration"] = {
+            "protocol": "Synchronous",
+            "engine": "spmd",
+        }
+        create["learner"]["dataStructure"] = {"nFeatures": 6}
+        reqs.write_text(json.dumps(create) + "\n")
+        reports = {}
+        for route, flag in (("fused", "auto"), ("packed", "false")):
+            perf = tmp_path / f"perf_{route}.jsonl"
+            rc = main(
+                [
+                    "--trainingData", str(train),
+                    "--requests", str(reqs),
+                    "--performanceOut", str(perf),
+                    "--parallelism", "2",
+                    "--batchSize", "64",
+                    "--testSetSize", "32",
+                    "--fusedIngest", flag,
+                ]
+            )
+            assert rc == 0
+            [line] = perf.read_text().strip().splitlines()
+            [stats] = json.loads(line)["statistics"]
+            reports[route] = stats
+        assert reports["fused"]["fitted"] == reports["packed"]["fitted"]
+        assert reports["fused"]["score"] == pytest.approx(
+            reports["packed"]["score"], rel=1e-5
+        )
+
     def test_combined_events_preserves_order(self, tmp_path):
         combined = tmp_path / "events.jsonl"
         resp_out = tmp_path / "responses.jsonl"

@@ -1,0 +1,209 @@
+"""Fused C ingest (omldm_parse_stage) parity with the packed numpy route.
+
+The fused loop (SPMDBridge.ingest_file) must be indistinguishable from
+feeding the same file through iter_file_batches -> process_packed_batch:
+same trained parameters, same fitted count, same holdout ring, same
+predictions in the same order — including forecasts mid-stream, Python-
+fallback lines (categorical features), invalid lines, EOS markers, and
+hashed-categorical layouts.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.ops.native import fast_parser_available
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.fast_ingest import iter_file_batches
+from omldm_tpu.runtime.job import REQUEST_STREAM
+
+pytestmark = pytest.mark.skipif(
+    not fast_parser_available(), reason="native parser unavailable"
+)
+
+DIM = 12
+
+
+def _create_request(protocol="Synchronous", extra=None, learner=None):
+    return {
+        "id": 0,
+        "request": "Create",
+        "learner": learner
+        or {
+            "name": "PA",
+            "hyperParameters": {"C": 0.1},
+            "dataStructure": {"nFeatures": DIM},
+        },
+        "preProcessors": [],
+        "trainingConfiguration": {
+            "protocol": protocol,
+            "engine": "spmd",
+            "extra": {"stageChain": 2, **(extra or {})},
+        },
+    }
+
+
+def _write_stream(path, n=4000, dim=DIM, seed=0, specials=True):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    with open(path, "w") as f:
+        for i in range(n):
+            x = np.round(rng.randn(dim), 6)
+            y = 1.0 if float(x @ w) > 0 else -1.0
+            if specials and i % 97 == 13:
+                f.write("EOS\n")
+            if specials and i % 211 == 50:
+                f.write("{bad json]\n")
+            if specials and i % 89 == 7:
+                # forecast row (no target)
+                f.write(
+                    json.dumps(
+                        {
+                            "numericalFeatures": [round(float(v), 6) for v in x],
+                            "operation": "forecasting",
+                        }
+                    )
+                    + "\n"
+                )
+                continue
+            if specials and i % 131 == 29:
+                # categorical features: Python-codec fallback line
+                f.write(
+                    json.dumps(
+                        {
+                            "numericalFeatures": [round(float(v), 6) for v in x],
+                            "categoricalFeatures": ["red", "large"],
+                            "target": y,
+                            "operation": "training",
+                        }
+                    )
+                    + "\n"
+                )
+                continue
+            f.write(
+                json.dumps(
+                    {
+                        "numericalFeatures": [round(float(v), 6) for v in x],
+                        "target": y,
+                        "operation": "training",
+                    }
+                )
+                + "\n"
+            )
+
+
+def _make_job(request, parallelism=2, batch_size=64, test=True):
+    preds = []
+    config = JobConfig(
+        parallelism=parallelism, batch_size=batch_size, test=test,
+        test_set_size=32,
+    )
+    job = StreamJob(config)
+    job.set_sinks(on_prediction=preds.append)
+    job.process_event(REQUEST_STREAM, json.dumps(request))
+    return job, preds
+
+
+def _dim_for(request):
+    hash_dims = int(
+        request["trainingConfiguration"]["extra"].get("hashDims", 0)
+    )
+    return request["learner"]["dataStructure"]["nFeatures"] + hash_dims
+
+
+def _run_packed(request, path, **job_kw):
+    job, preds = _make_job(request, **job_kw)
+    dim = _dim_for(request)
+    hash_dims = int(
+        request["trainingConfiguration"]["extra"].get("hashDims", 0)
+    )
+    for batch in iter_file_batches(path, dim, 1024, hash_dims):
+        job.process_packed_batch(*batch)
+    [bridge] = job.spmd_bridges.values()
+    bridge.flush()
+    return job, bridge, preds
+
+
+def _run_fused(request, path, **job_kw):
+    job, preds = _make_job(request, **job_kw)
+    job.ensure_deployed(_dim_for(request))
+    assert job.run_file_fused(path), "job should qualify for fused ingest"
+    [bridge] = job.spmd_bridges.values()
+    bridge.flush()
+    return job, bridge, preds
+
+
+def _assert_parity(request, path, **job_kw):
+    job_a, bridge_a, preds_a = _run_packed(request, path, **job_kw)
+    job_b, bridge_b, preds_b = _run_fused(request, path, **job_kw)
+    np.testing.assert_allclose(
+        np.asarray(bridge_a.trainer.global_flat_params()),
+        np.asarray(bridge_b.trainer.global_flat_params()),
+        rtol=1e-6, atol=1e-6,
+    )
+    assert bridge_a.trainer.fitted == bridge_b.trainer.fitted
+    assert bridge_a.holdout_count == bridge_b.holdout_count
+    assert bridge_a.test_set._n == bridge_b.test_set._n
+    assert bridge_a.test_set._head == bridge_b.test_set._head
+    np.testing.assert_array_equal(bridge_a.test_set._x, bridge_b.test_set._x)
+    np.testing.assert_array_equal(bridge_a.test_set._y, bridge_b.test_set._y)
+    assert len(preds_a) == len(preds_b)
+    for pa, pb in zip(preds_a, preds_b):
+        assert pa.value == pytest.approx(pb.value, rel=1e-6)
+
+
+class TestFusedParity:
+    def test_mixed_stream(self, tmp_path):
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path)
+        _assert_parity(_create_request(), path)
+
+    def test_no_holdout(self, tmp_path):
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path, n=2000)
+        _assert_parity(_create_request(), path, test=False)
+
+    def test_hashed_categoricals(self, tmp_path):
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path, n=2000)
+        req = _create_request(extra={"hashDims": 4})
+        _assert_parity(req, path)
+
+    def test_ssp_paced(self, tmp_path):
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path, n=2000, specials=False)
+        req = _create_request(
+            protocol="SSP", extra={"staleness": 2, "syncEvery": 4}
+        )
+        _assert_parity(req, path)
+
+    def test_plain_stream_counts(self, tmp_path):
+        """No specials: every row lands in training or the holdout ring."""
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path, n=3000, specials=False)
+        _, bridge, preds = _run_fused(_create_request(), path)
+        assert not preds
+        assert bridge.holdout_count == 3000
+        # 20% of rows enter the ring; the ring holds the last 32
+        assert bridge.test_set._n == 32
+        assert bridge.trainer.fitted + bridge.test_set._n == 3000
+
+
+class TestFusedQualification:
+    def test_host_plane_job_does_not_qualify(self, tmp_path):
+        path = str(tmp_path / "train.jsonl")
+        _write_stream(path, n=100, specials=False)
+        req = _create_request()
+        del req["trainingConfiguration"]["engine"]
+        job, _ = _make_job(req)
+        job.ensure_deployed(DIM)
+        assert job.fused_file_bridge() is None
+        assert not job.run_file_fused(path)
+
+    def test_fp16_feed_does_not_qualify(self, tmp_path):
+        req = _create_request(extra={"feedDtype": "float16"})
+        job, _ = _make_job(req)
+        job.ensure_deployed(DIM)
+        assert job.fused_file_bridge() is None
