@@ -1,0 +1,111 @@
+"""Sweep Pallas flash-attention tile sizes on the real chip (bf16 + f32).
+
+Prints TFLOP/s per (block_q, block_k) for causal L=8192 forward and
+train fwd+bwd, tunnel-corrected the same way run_benchmarks does (chained
+applications inside one jitted program, fixed round trip subtracted).
+The winner becomes DEFAULT_BLOCK_Q/DEFAULT_BLOCK_K in ops/attention.py.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from omldm_tpu.ops import attention as A
+
+    assert jax.devices()[0].platform == "tpu", "tuner needs the real chip"
+    rng = np.random.RandomState(0)
+    b, l, h, dh = 4, 8192, 8, 64
+    flops = 4 * b * h * l * l * dh / 2  # causal half
+
+    def chain_time(apply, x0, chain):
+        @jax.jit
+        def run(x):
+            def body(c, _):
+                return apply(c), ()
+
+            c, _ = jax.lax.scan(body, x, None, length=chain)
+            return c.sum()
+
+        @jax.jit
+        def rt(x):
+            return x.sum()
+
+        float(np.asarray(run(x0)))
+        float(np.asarray(rt(x0)))
+        t0 = time.perf_counter()
+        float(np.asarray(rt(x0)))
+        t_rt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(run(x0)))
+        total = time.perf_counter() - t0
+        return max(total - t_rt, 1e-9) / chain
+
+    import itertools
+
+    configs = [
+        tuple(int(x) for x in c.split("x"))
+        for c in (sys.argv[1].split(",") if len(sys.argv) > 1 else
+                  ["512x512", "512x1024", "1024x512", "1024x1024"])
+    ]
+    dtypes = (
+        [jnp.bfloat16, jnp.float32] if len(sys.argv) <= 2
+        else [dict(bf16=jnp.bfloat16, f32=jnp.float32)[d]
+              for d in sys.argv[2].split(",")]
+    )
+    for dtype in dtypes:
+        q = jnp.asarray(rng.randn(b, l, h, dh) * 0.1, dtype)
+        k = jnp.asarray(rng.randn(b, l, h, dh) * 0.1, dtype)
+        v = jnp.asarray(rng.randn(b, l, h, dh) * 0.1, dtype)
+        q1, k1, v1 = q[:1], k[:1], v[:1]
+        for bq, bk in configs:
+            A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K = bq, bk
+            name = np.dtype(dtype).name
+            try:
+                t_f = chain_time(
+                    lambda x: A.flash_attention_pallas(
+                        x, k, v, causal=True, block_q=bq, block_k=bk
+                    ),
+                    q, chain=32,
+                )
+                print(
+                    f"{name:9s} bq={bq:5d} bk={bk:5d}  "
+                    f"fwd {flops / t_f / 1e12:7.2f} TF/s", flush=True,
+                )
+                # grad over ALL inputs: a q-only grad lets XLA dead-code
+                # -eliminate the whole dk/dv kernel and overstate train
+                g = jax.grad(
+                    lambda q_, k_, v_: A._flash_diff(
+                        q_, k_, v_, True, 0, 0
+                    ).sum(),
+                    argnums=(0, 1, 2),
+                )
+
+                def train_step(x):
+                    dq, dk, dv = g(x, k1, v1)
+                    return dq + dk + dv
+
+                t_g = chain_time(train_step, q1, chain=16)
+                print(
+                    f"{name:9s} bq={bq:5d} bk={bk:5d}  "
+                    f"train {(flops / b) * 3.5 / t_g / 1e12:7.2f} TF/s",
+                    flush=True,
+                )
+            except Exception as e:
+                print(
+                    f"{name:9s} bq={bq:5d} bk={bk:5d}  "
+                    f"FAILED: {type(e).__name__}: {str(e)[:120]}",
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

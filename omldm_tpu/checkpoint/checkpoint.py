@@ -198,10 +198,16 @@ class CheckpointManager:
             self.directory,
             f"ckpt_{int(time.time()*1000):013d}_{self._seq:06d}.pkl",
         )
-        with open(path, "wb") as f:
+        # atomic writes (temp + os.replace): a crash mid-write must never
+        # leave a truncated snapshot or an empty 'latest' pointer — the
+        # supervised-recovery path reads both
+        with open(path + ".tmp", "wb") as f:
             pickle.dump(snapshot, f)
-        with open(os.path.join(self.directory, "latest"), "w") as f:
+        os.replace(path + ".tmp", path)
+        pointer = os.path.join(self.directory, "latest")
+        with open(pointer + ".tmp", "w") as f:
             f.write(os.path.basename(path))
+        os.replace(pointer + ".tmp", pointer)
         self._last_save = time.time()
         self._prune()
         return path
@@ -277,7 +283,11 @@ class CheckpointManager:
         if not os.path.exists(pointer):
             return None
         with open(pointer) as f:
-            return os.path.join(self.directory, f.read().strip())
+            name = f.read().strip()
+        if not name:  # empty/corrupt pointer = no checkpoint, not a crash
+            return None
+        path = os.path.join(self.directory, name)
+        return path if os.path.exists(path) else None
 
     def restore(self, parallelism: Optional[int] = None, path: Optional[str] = None):
         """Rebuild a StreamJob from a snapshot; ``parallelism`` overrides the
@@ -361,9 +371,12 @@ class CheckpointManager:
 
         Same mesh shape: exact shard-by-shard re-placement. Different shape
         (restore under a different parallelism/device count): every worker
-        replica seeds from the saved worker-0 model — post-sync replicas
-        agree, so worker 0 IS the fleet model — with progress counters
-        carried and staleness clocks restarted coherently at zero."""
+        replica seeds from the MEAN of the saved dp replicas — checkpoints
+        are taken between events, not at sync barriers, so under
+        Asynchronous/SSP/EASGD the replicas diverge mid-round and the mean
+        preserves every worker's progress (mirroring the host-plane rescale
+        merge in _restore_network); progress counters carry worker-0's
+        values and staleness clocks restart coherently at zero."""
         bridge = job.spmd_bridges.get(net_id)
         if bridge is None:
             return
@@ -381,13 +394,22 @@ class CheckpointManager:
                     l, (t.dp, t.hub) + l.shape
                 ).copy()
 
+            def merge_tile(leaf):
+                # model-bearing leaves: mean over the dp replicas (hub
+                # shard 0 — hub replicas agree by construction) so
+                # mid-round divergence is merged, not discarded
+                l = np.asarray(leaf)
+                m = l[:, 0].mean(axis=0).astype(l.dtype)
+                return np.broadcast_to(m, (t.dp, t.hub) + m.shape).copy()
+
             new_state = {
-                "params": jax.tree_util.tree_map(tile, fleet["params"]),
+                "params": jax.tree_util.tree_map(merge_tile, fleet["params"]),
                 "preps": [
-                    jax.tree_util.tree_map(tile, p) for p in fleet["preps"]
+                    jax.tree_util.tree_map(merge_tile, p)
+                    for p in fleet["preps"]
                 ],
-                "est": tile(fleet["est"]),
-                "center": tile(fleet["center"]),
+                "est": merge_tile(fleet["est"]),
+                "center": merge_tile(fleet["center"]),
                 "step": tile(fleet["step"]),
                 "syncs": tile(fleet["syncs"]),
                 "cum_loss": tile(fleet["cum_loss"]),

@@ -32,6 +32,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Default Pallas tile sizes. Forward and backward prefer different shapes
+# on v5e (bf16, causal L=8192, dh=64 — benchmarks/tune_flash_blocks.py):
+# the forward is fastest at 1024x1024, the dq/dkdv backward passes at
+# 512x1024. Override per call via block_q/block_k.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+DEFAULT_BWD_BLOCK_Q = 512
+DEFAULT_BWD_BLOCK_K = 1024
+
 
 def mha_reference(
     q: jnp.ndarray,
@@ -153,25 +162,39 @@ def blockwise_attention(
 def _masked_scores(q_ref, k_ref, qi, ki, *, causal, q_offset, kv_offset, lk):
     """Scaled QK^T for one (Q tile, K tile) pair with the K-padding and
     causal masks applied — the ONE implementation all three kernels
-    (forward, dq, dk/dv) share so their masking can never diverge."""
+    (forward, dq, dk/dv) share so their masking can never diverge.
+
+    The K-padding mask is STATICALLY skipped when Lk divides the tile
+    evenly (no padded keys exist) — measured worthwhile. Runtime-
+    conditional masking (lax.cond on a per-block scalar) was tried for the
+    causal mask and REGRESSED ~40% on v5e: Mosaic serializes around the
+    branch, costing more than the elementwise mask it saves. So the causal
+    mask stays unconditional."""
     block_q, dh = q_ref.shape
     block_k = k_ref.shape[0]
-    q = q_ref[...].astype(jnp.float32)
-    k = k_ref[...].astype(jnp.float32)
+    # operands keep their storage dtype: bf16 x bf16 -> f32 runs the MXU at
+    # full rate (casting to f32 first halves/quarters it); accumulation is
+    # always f32 via preferred_element_type
+    q = q_ref[...]
+    k = k_ref[...]
     scale = 1.0 / jnp.sqrt(float(dh))
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    ki_local = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    s = jnp.where(ki_local < lk, s, NEG_INF)
-    if causal:
-        q_pos = (
-            q_offset + qi * block_q
-            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    need_pad_mask = lk % block_k != 0  # static: no padded keys otherwise
+    if need_pad_mask or causal:
+        ki_local = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(q_pos >= kv_offset + ki_local, s, NEG_INF)
+        if need_pad_mask:
+            s = jnp.where(ki_local < lk, s, NEG_INF)
+        if causal:
+            q_pos = (
+                q_offset + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            s = jnp.where(q_pos >= kv_offset + ki_local, s, NEG_INF)
     return s, scale
 
 
@@ -181,6 +204,34 @@ def _causal_block_needed(qi, ki, block_q, block_k, q_offset, kv_offset):
     return (q_offset + qi * block_q + block_q - 1) >= (
         kv_offset + ki * block_k
     )
+
+
+def _causal_kv_index(block_q, block_k, q_offset, kv_offset):
+    """K/V BlockSpec index_map for causal grids (B*H, q tile j, k step kk):
+    clamp the k index to the LAST needed tile for this Q tile. Skipped
+    steps (kk past the diagonal) then map to the same block as the step
+    before, and Mosaic elides the repeat DMA — pl.when alone skips the
+    compute but still paid the HBM->VMEM copy for every masked block
+    (~2x the needed K/V traffic at long context)."""
+
+    def index_map(i, j, kk):
+        last = (q_offset + (j + 1) * block_q - 1 - kv_offset) // block_k
+        return (i, jnp.minimum(kk, jnp.maximum(last, 0)), 0)
+
+    return index_map
+
+
+def _causal_q_index(block_q, block_k, q_offset, kv_offset, n_q):
+    """Q-side BlockSpec index_map for the dK/dV grid (B*H, k tile a, q step
+    b_): clamp to the FIRST needed Q tile for this K tile (the skipped
+    steps sit at the sweep's start), same DMA-elision trick as above."""
+
+    def index_map(i, a, b_):
+        first = (kv_offset + a * block_k - q_offset) // block_q
+        first = jnp.minimum(jnp.maximum(first, 0), n_q - 1)
+        return (i, jnp.maximum(b_, first), 0)
+
+    return index_map
 
 
 def _vma_struct_factory(ref_array):
@@ -245,28 +296,57 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(needed)
     def _block():
-        v = v_ref[...].astype(jnp.float32)
         s, _ = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
                               q_offset=q_offset, kv_offset=kv_offset, lk=lk)
-        m_prev = m_ref[...]  # [bq, 1]
+        # m/l scratch is LANES wide with every lane identical: subtracting
+        # a [bq, 1] vector from the [bq, bk] scores broadcasts from lane 0,
+        # which the VPU does poorly — pltpu.repeat of a full vreg is cheap
+        # (the jax reference flash kernel's MIN_BLOCK_SIZE trick)
+        m_prev = m_ref[...]  # [bq, LANES]
         l_prev = l_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        lanes = m_prev.shape[-1]
+        m_curr = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_curr)          # [bq, LANES]
+        if block_k % lanes == 0 and block_k > lanes:
+            m_rep = pltpu.repeat(m_new, block_k // lanes, axis=1)
+        elif block_k <= lanes:
+            m_rep = m_new[:, :block_k]
+        else:  # ragged block_k (< full tiles): lane-0 broadcast fallback
+            m_rep = jnp.broadcast_to(m_new[:, :1], s.shape)
         # same fully-masked-row guard as the blockwise/ring variants
-        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
-        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_rep))
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))  # [bq, LANES]
         l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[...] = m_new
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        if dh > lanes and dh % lanes == 0:
+            alpha_dh = pltpu.repeat(alpha, dh // lanes, axis=1)
+        elif dh <= lanes:
+            alpha_dh = alpha[:, :dh]
+        else:  # ragged dh: lane-0 broadcast fallback
+            alpha_dh = jnp.broadcast_to(alpha[:, :1], acc_ref.shape)
+        # P quantizes to the value dtype for the PV matmul (bf16 MXU rate;
+        # identity for f32 inputs) — the accumulator stays f32
+        acc_ref[...] = acc_ref[...] * alpha_dh + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == n_k - 1)
     def _finish():
+        lanes_f = l_ref.shape[-1]
+        if dh > lanes_f and dh % lanes_f == 0:
+            l_dh = pltpu.repeat(l_ref[...], dh // lanes_f, axis=1)
+        elif dh <= lanes_f:
+            l_dh = l_ref[:, :dh]
+        else:
+            l_dh = jnp.broadcast_to(l_ref[:, :1], acc_ref.shape)
         o_ref[...] = (
-            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+            acc_ref[...] / jnp.maximum(l_dh, 1e-30)
         ).astype(o_ref.dtype)
         # per-row logsumexp: the backward kernels recompute P from S - lse
-        lse_ref[...] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse_ref[...] = (
+            m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        )
 
 
 @functools.partial(
@@ -279,8 +359,8 @@ def flash_attention_pallas(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     q_offset: int = 0,
     kv_offset: int = 0,
     interpret: bool = False,
@@ -296,8 +376,8 @@ def flash_attention_pallas(
     lax blockwise scan). Use ``interpret=True`` on CPU."""
     b, lq, h, dh = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q = min(block_q or DEFAULT_BLOCK_Q, lq)
+    block_k = min(block_k or DEFAULT_BLOCK_K, lk)
     pad_q = (-lq) % block_q
     pad_k = (-lk) % block_k
 
@@ -320,14 +400,22 @@ def flash_attention_pallas(
     )
     n_k = (lk + pad_k) // block_k
     grid = (b * h, (lq + pad_q) // block_q, n_k)
+    # m/l scratch is a full 128-lane vreg wide (every lane identical): the
+    # kernel expands it over the score block with pltpu.repeat instead of
+    # a slow lane-0 broadcast
+    lanes = 128
     scratch = [
-        pltpu.VMEM((block_q, dh), jnp.float32),   # acc
-        pltpu.VMEM((block_q, 1), jnp.float32),    # m (running max)
-        pltpu.VMEM((block_q, 1), jnp.float32),    # l (running denom)
+        pltpu.VMEM((block_q, dh), jnp.float32),     # acc
+        pltpu.VMEM((block_q, lanes), jnp.float32),  # m (running max)
+        pltpu.VMEM((block_q, lanes), jnp.float32),  # l (running denom)
     ]
     # the K axis carries the accumulators: sequential ("arbitrary");
     # B*H and the Q tiles are embarrassingly parallel
     kwargs = _tpu_compiler_kwargs(interpret)
+    kv_index = (
+        _causal_kv_index(block_q, block_k, q_offset, kv_offset)
+        if causal else (lambda i, j, kk: (i, kk, 0))
+    )
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel,
@@ -340,8 +428,8 @@ def flash_attention_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, dh), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((None, block_k, dh), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((None, block_k, dh), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((None, block_k, dh), kv_index),
+            pl.BlockSpec((None, block_k, dh), kv_index),
         ],
         out_specs=(
             pl.BlockSpec((None, block_q, dh), lambda i, j, kk: (i, j, 0)),
@@ -382,19 +470,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _block():
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
         s, scale = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
                                   q_offset=q_offset, kv_offset=kv_offset,
                                   lk=lk)
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[...]))
+        # storage-dtype operands, f32 accumulators (see _masked_scores)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[...])
         acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k_ref.dtype), k_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ) * scale
 
     @pl.when(ki == n_k - 1)
@@ -425,24 +513,25 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _block():
-        q = q_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
         s, scale = _masked_scores(q_ref, k_ref, qi, ki, causal=causal,
                                   q_offset=q_offset, kv_offset=kv_offset,
                                   lk=lk)
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse_ref[...]))
+        # storage-dtype operands, f32 accumulators (see _masked_scores)
         # dV += P^T dO
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do_ref.dtype), do_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[...])
         # dK += dS^T Q * scale
         dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q_ref.dtype), q_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         ) * scale
 
     @pl.when(qi == n_q - 1)
@@ -476,8 +565,8 @@ def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
     q, k, v, out, lse = res
     b, lq, h, dh = q.shape
     lk = k.shape[1]
-    block_q = min(512, lq)
-    block_k = min(512, lk)
+    block_q = min(DEFAULT_BWD_BLOCK_Q, lq)
+    block_k = min(DEFAULT_BWD_BLOCK_K, lk)
     pad_q = (-lq) % block_q
     pad_k = (-lk) % block_k
     n_q = (lq + pad_q) // block_q
@@ -491,6 +580,12 @@ def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
 
     qf, kf, vf = flat(q, pad_q), flat(k, pad_k), flat(v, pad_k)
     dof, of = flat(g, pad_q), flat(out, pad_q)
+    # the forward saved lse under ITS q padding (fwd/bwd tile sizes may
+    # differ); re-pad to this pass's layout. Zero pad rows are inert: the
+    # cotangent is zero there, so every pad contribution cancels.
+    lse = lse[:, :lq]
+    if pad_q:
+        lse = jnp.pad(lse, ((0, 0), (0, pad_q), (0, 0)))
     # delta_i = rowsum(dO * O) per query row — tiny elementwise op, fused
     # by XLA around the kernels
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
@@ -502,7 +597,13 @@ def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
     kwargs = _tpu_compiler_kwargs(interpret)
     q_spec = pl.BlockSpec((None, block_q, dh), lambda i, a, b_: (i, a, 0))
     row_spec = pl.BlockSpec((None, block_q, 1), lambda i, a, b_: (i, a, 0))
-    kv_spec = pl.BlockSpec((None, block_k, dh), lambda i, a, b_: (i, b_, 0))
+    # causal: clamp skipped K steps to the last needed tile so their DMA
+    # is elided (see _causal_kv_index)
+    kv_map = (
+        _causal_kv_index(block_q, block_k, q_offset, kv_offset)
+        if causal else (lambda i, a, b_: (i, b_, 0))
+    )
+    kv_spec = pl.BlockSpec((None, block_k, dh), kv_map)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -518,9 +619,15 @@ def _flash_diff_bwd(causal, q_offset, kv_offset, interpret, res, g):
         **kwargs,
     )(qf, kf, vf, dof, lse, delta)
 
-    # dK/dV pass: grid axes swap roles — a/b_ are (k tile, q tile)
-    q_spec2 = pl.BlockSpec((None, block_q, dh), lambda i, a, b_: (i, b_, 0))
-    row_spec2 = pl.BlockSpec((None, block_q, 1), lambda i, a, b_: (i, b_, 0))
+    # dK/dV pass: grid axes swap roles — a/b_ are (k tile, q tile). The
+    # causal-skipped q steps sit at the sweep start; clamp their index to
+    # the first needed tile (DMA elision again).
+    q_map = (
+        _causal_q_index(block_q, block_k, q_offset, kv_offset, n_q)
+        if causal else (lambda i, a, b_: (i, b_, 0))
+    )
+    q_spec2 = pl.BlockSpec((None, block_q, dh), q_map)
+    row_spec2 = pl.BlockSpec((None, block_q, 1), q_map)
     kv_spec2 = pl.BlockSpec((None, block_k, dh), lambda i, a, b_: (i, a, 0))
     dk, dv = pl.pallas_call(
         functools.partial(

@@ -108,6 +108,16 @@ inline int parse_digit_run(const char*& p, const char* end, uint64_t& mant) {
   return digits;
 }
 
+// float32 boundary clamp, identical to the Python side's
+// runtime/vectorizer.clamp_f32: finite doubles beyond float32 range store
+// as +/-FLT_MAX instead of overflowing to inf (inf would poison device
+// state); parity pinned by tests/test_parser_fuzz.py.
+inline float to_f32_clamped(double v) {
+  if (v > 3.4028234663852886e38) return 3.4028234663852886e38f;
+  if (v < -3.4028234663852886e38) return -3.4028234663852886e38f;
+  return static_cast<float>(v);
+}
+
 struct Cursor {
   const char* p;
   const char* end;
@@ -253,10 +263,51 @@ inline bool parse_num_array(Cursor& c, float* dst, int cap, int* count) {
     *count = 0;
     return true;
   }
+  // Fast lane for the dominant serialized-float shape: "[-]d.dddddd"
+  // elements separated by "', '" (what %.6f streams emit). The win over
+  // parse_number is the pointer-advance chain: the next element's start
+  // depends only on the sign byte (fixed width otherwise), not on the
+  // digit-run classify (ctz) of the current one, so the CPU overlaps
+  // several elements' parses. Bit-identical math to the one-window fast
+  // path (same mant construction, same kPow10 divide); any other shape
+  // falls through to the general loop with the element unconsumed.
+  while (c.end - c.p >= 11) {
+    const char* e = c.p;
+    bool eneg = (*e == '-');
+    e += eneg;
+    uint64_t c8;
+    memcpy(&c8, e, 8);
+    uint64_t t = c8 ^ 0x3030303030303030ull;
+    uint64_t ndm = ((t + 0x7676767676767676ull) | t) & 0x8080808080808080ull;
+    // exactly byte 1 non-digit (and it must be '.'): d . d d d d d d
+    if (ndm != 0x8000ull || ((c8 >> 8) & 0xFFull) != '.') break;
+    char sep = e[8];
+    if (sep != ',' && sep != ']') break;  // longer fraction / exp / ws
+    uint64_t d0 = c8 & 0x0Full;
+    uint64_t shifted = ((c8 >> 16) << 16) | (0x3030303030303030ull >> 48);
+    uint64_t mant = d0 * kPow10u[6] + swar8(shifted);
+    double v = static_cast<double>(mant) / kPow10[6];
+    uint64_t vb;
+    memcpy(&vb, &v, 8);
+    vb ^= static_cast<uint64_t>(eneg) << 63;
+    memcpy(&v, &vb, 8);
+    if (n < cap) dst[n] = to_f32_clamped(v);
+    ++n;
+    if (sep == ']') {
+      c.p = e + 9;
+      *count = (n < cap) ? n : cap;
+      return true;
+    }
+    c.p = e + 9;
+    if (c.p < c.end && *c.p == ' ') ++c.p;
+    if (c.p < c.end && (*c.p == ' ' || *c.p == '\t' || *c.p == '\n' ||
+                        *c.p == '\r'))
+      skip_ws(c);
+  }
   while (c.p < c.end) {
     double v;
     if (!parse_number(c, &v)) return false;
-    if (n < cap) dst[n] = static_cast<float>(v);
+    if (n < cap) dst[n] = to_f32_clamped(v);
     ++n;
     if (c.p >= c.end) return false;
     char ch = *c.p;
@@ -657,7 +708,7 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
     }
   }
   bool any = num_cnt > 0 || disc_cnt > 0;
-  if (have_target) *yi = static_cast<float>(target);
+  if (have_target) *yi = to_f32_clamped(target);
   if (have_op) {
     if (op_val < 0) return;  // unknown operation: drop
     *opi = static_cast<unsigned char>(op_val);

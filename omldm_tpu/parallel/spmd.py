@@ -122,6 +122,11 @@ class SPMDTrainer:
         if self.learner.host_side:
             raise ValueError("host-side learners cannot run in the SPMD engine")
         self.preps = [make_preprocessor(p) for p in preprocessor_specs]
+        if getattr(self.learner, "sparse", False) and self.preps:
+            raise ValueError(
+                "sparse learners take padded-COO batches; streaming "
+                "preprocessors are a dense-feature concept"
+            )
         self.dim = dim
         self.batch_size = batch_size
         self.sync_every = int(self.tc.extra.get("syncEvery", 4))
@@ -233,6 +238,9 @@ class SPMDTrainer:
             # and the host's pacing/requeue decisions are driven by these
             "clock": izero.copy(),
             "accepted": stack(np.ones((self.dp,), np.float32)),
+            # steps on which the gated Async/SSP fold allreduce actually
+            # executed (physical collective rounds; 0 for other protocols)
+            "fold_rounds": izero.copy(),
         }
 
     # --- the per-shard step ---
@@ -268,11 +276,21 @@ class SPMDTrainer:
 
         staleness = self.staleness
 
+        sparse = getattr(learner, "sparse", False)
+
         def step_fn(state, x, y, mask):
-            # per-shard views: state leaves [1,1,...]; batch [1,B,D].
-            # Inputs may arrive in a narrow feed dtype (float16 staging
-            # halves host->device bytes); compute is always f32.
-            x = _pvary(x[0].astype(jnp.float32), "hub")
+            # per-shard views: state leaves [1,1,...]; batch [1,B,D] dense
+            # or ([1,B,K] idx, [1,B,K] val) padded-COO. Inputs may arrive
+            # in a narrow feed dtype (float16 staging halves host->device
+            # bytes); compute is always f32.
+            if sparse:
+                idx, val = x
+                x = (
+                    _pvary(idx[0], "hub"),
+                    _pvary(val[0].astype(jnp.float32), "hub"),
+                )
+            else:
+                x = _pvary(x[0].astype(jnp.float32), "hub")
             y = _pvary(y[0].astype(jnp.float32), "hub")
             mask = _pvary(mask[0].astype(jnp.float32), "hub")
             params = jax.tree_util.tree_map(_sq, state["params"])
@@ -283,6 +301,7 @@ class SPMDTrainer:
             syncs = _sq(state["syncs"])
             cum_loss = _sq(state["cum_loss"])
             clock = _sq(state["clock"])
+            fold_rounds = _sq(state["fold_rounds"])
 
             old_params = params
             old_preps = prep_states
@@ -375,16 +394,30 @@ class SPMDTrainer:
                     for s, s0 in zip(new_preps, old_preps)
                 ]
                 loss = jnp.where(allowed, loss, 0.0)
-                # PS push at the worker's own clock cadence; the collective
-                # itself runs unconditionally (SPMD), refused workers
-                # contribute zero
+                # PS push at the worker's own clock cadence. The param-sized
+                # fold allreduce is GATED the way GM/FGM gate their sync: a
+                # 1-scalar psum vote ("does anyone fold this step?") and the
+                # collective under lax.cond — steps where no worker folds
+                # ship only the scalar vote over ICI, so physical bytes
+                # track logical folds (~syncEvery x fewer param collectives)
+                # instead of paying lockstep traffic for async semantics
                 my_turn = jnp.logical_and(
                     allowed, (clock % sync_every) == 0
                 )
+                any_fold = (
+                    jax.lax.psum(my_turn.astype(jnp.float32), "dp") > 0.0
+                )
                 contrib = jnp.where(my_turn, flat - est, jnp.zeros_like(flat))
-                # shared global accumulates mean deltas (PS fold), routed
-                # through the hub shards like every other collective
-                center = center + self._ps_allreduce(contrib)
+
+                def do_fold(c, fr):
+                    # shared global accumulates mean deltas (PS fold),
+                    # routed through the hub shards like every collective
+                    return c + self._ps_allreduce(contrib), fr + 1
+
+                center, fold_rounds = jax.lax.cond(
+                    any_fold, do_fold, lambda c, fr: (c, fr),
+                    center, fold_rounds,
+                )
                 flat = jnp.where(my_turn, center, flat)
                 est = jnp.where(my_turn, center, est)
                 syncs = syncs + my_turn.astype(jnp.int32)
@@ -408,6 +441,7 @@ class SPMDTrainer:
                 "cum_loss": _unsq(cum_loss),
                 "clock": _unsq(clock),
                 "accepted": _unsq(accepted),
+                "fold_rounds": _unsq(fold_rounds),
             }
             return new_state, _unsq(loss)
 
@@ -550,32 +584,46 @@ class SPMDTrainer:
           that worker's params up and the global back down
           (2 * flat * 4B). For Sync/EASGD/GM/FGM the round counter covers
           all dp workers; Async/SSP count per-worker folds directly.
-        - GM/FGM violation/safe-zone vote: a 1-scalar psum EVERY step per
-          worker (the protocol's cheap control channel) — 2 * 4B per
-          worker-step, read from the device ``step`` counter. This is the
-          traffic the communication-skipping protocols pay even in silent
-          rounds, previously uncounted.
+        - GM/FGM violation/safe-zone vote and the Async/SSP fold vote
+          (+ SSP's min-clock pmin): a 1-scalar collective EVERY step per
+          worker (the protocols' cheap control channel) — 2 * 4B per
+          worker-step per channel, read from the device ``step`` counter.
+          This is the traffic the communication-skipping protocols pay
+          even in silent rounds.
         """
         syncs = np.asarray(jax.device_get(self.state["syncs"]))
         param_bytes = 2 * self.flat_size * 4
+        steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
         if self.protocol in ("Asynchronous", "SSP"):
             total = int(syncs[:, 0].sum()) * param_bytes
+            channels = 2 if self.protocol == "SSP" else 1
+            total += steps * self.dp * channels * 2 * 4
         else:
             total = int(syncs[0, 0]) * self.dp * param_bytes
         if self.protocol in ("GM", "FGM"):
-            steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
             total += steps * self.dp * 2 * 4
         return total
 
     def collective_bytes_physical(self) -> int:
         """Bytes the HARDWARE moved, as opposed to the application-payload
-        accounting above: Async/SSP run their fold allreduce every step
-        (zero contributions still traverse ICI in lockstep SPMD), so their
-        physical traffic is per-step, not per-accepted-fold."""
-        steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
+        accounting above. The Async/SSP fold allreduce is gated on a
+        1-scalar vote (see _build_step), so its physical traffic is
+        per-EXECUTED-round (the device ``fold_rounds`` counter), not
+        per-step — plus the per-step scalar vote channel(s). When folds
+        line up across workers the physical figure approaches
+        bytes_shipped / dp-concurrency; it can exceed bytes_shipped only
+        by the scalar control traffic."""
         param_bytes = 2 * self.flat_size * 4
         if self.protocol in ("Asynchronous", "SSP"):
-            return steps * self.dp * param_bytes
+            steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
+            rounds = int(
+                np.asarray(jax.device_get(self.state["fold_rounds"]))[0, 0]
+            )
+            channels = 2 if self.protocol == "SSP" else 1
+            return (
+                rounds * self.dp * param_bytes
+                + steps * self.dp * channels * 2 * 4
+            )
         return self.bytes_shipped()
 
     def global_flat_params(self) -> np.ndarray:

@@ -20,7 +20,7 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from omldm_tpu.api.data import FORECASTING, DataInstance
-from omldm_tpu.runtime.vectorizer import Vectorizer
+from omldm_tpu.runtime.vectorizer import F32_MAX, Vectorizer
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]
 
@@ -85,7 +85,11 @@ class PackedBatcher:
                     valid[i] = 0
                     continue
                 out[i] = self.vec.vectorize(inst)
-                y[i] = 0.0 if inst.target is None else inst.target
+                # same float32 clamp the C parser applies to targets
+                y[i] = (
+                    0.0 if inst.target is None
+                    else min(max(float(inst.target), -F32_MAX), F32_MAX)
+                )
                 op[i] = 1 if inst.operation == FORECASTING else 0
                 valid[i] = 1
         keep = valid == 1
@@ -104,7 +108,10 @@ class PackedBatcher:
             if inst is None:
                 continue
             rows_x.append(self.vec.vectorize(inst))
-            rows_y.append(0.0 if inst.target is None else inst.target)
+            rows_y.append(
+                0.0 if inst.target is None
+                else min(max(float(inst.target), -F32_MAX), F32_MAX)
+            )
             rows_op.append(1 if inst.operation == FORECASTING else 0)
         if not rows_x:
             return (

@@ -158,10 +158,18 @@ def connect_kafka(
     ``position`` (a checkpoint's ``source_position``): manually assign the
     UNION of the topic map's partitions — partitions with a recorded
     next-offset seek there (seek-and-replay recovery, the consumer side of
-    Flink's restore-from-checkpoint); partitions the snapshot never saw a
-    record from seek to the beginning (nothing from them was consumed).
-    Under manual assignment, partitions created after the reconnect are
-    not picked up (same caveat as Flink restore without partition
+    Flink's restore-from-checkpoint). Partitions ABSENT from the snapshot
+    split by stream: request-topic partitions rewind to the beginning (a
+    fresh-state incarnation must re-consume Create/Update/Delete to rebuild
+    its topology — _run_kafka deliberately drops those keys), while data
+    partitions seek to the live END — the original consumer (subscribe
+    mode, latest) started at the log end, so an idle-before-snapshot or
+    created-after-snapshot partition must not replay retained history the
+    original job never consumed. At initial connect the ``tracker`` is
+    seeded with every partition's starting position (its end offset at
+    connect time) so snapshots record idle partitions as consumed-from-
+    start. Under manual assignment, partitions created after the reconnect
+    are not picked up (same caveat as Flink restore without partition
     discovery). ``tracker`` is threaded through to
     :func:`polling_events`."""
     try:
@@ -174,6 +182,20 @@ def connect_kafka(
             "file replay or in-memory events."
         ) from e
     topic_map = dict(topic_map or DEFAULT_TOPICS)
+
+    def _partitions_with_retry(consumer, topic):
+        # partitions_for_topic can transiently return None on a fresh
+        # client (metadata not fetched yet) — retry with backoff
+        import time as _time
+
+        for attempt in range(5):
+            if attempt:  # back off BEFORE each retry, not after the last
+                _time.sleep(0.2 * attempt)
+            parts = consumer.partitions_for_topic(topic)
+            if parts:
+                return parts
+        return None
+
     # consumer_timeout_ms bounds each poll so the iterator goes idle (raises
     # StopIteration, resumable) instead of blocking forever — required for
     # the silence-timer termination to ever fire on a quiet broker
@@ -184,21 +206,12 @@ def connect_kafka(
         )
         # union of the subscribed topics' partitions: a topic that never
         # delivered a record before the snapshot must still be consumed.
-        # partitions_for_topic can transiently return None on a fresh
-        # client (metadata not fetched yet) — retry before falling back
-        # to the snapshot-recorded partitions + partition 0, and say so:
-        # silently narrowing a multi-partition topic would lose data
-        import time as _time
-
+        # On metadata failure fall back to the snapshot-recorded
+        # partitions + partition 0, and say so: silently narrowing a
+        # multi-partition topic would lose data
         assigned = []
         for topic in topic_map:
-            parts = None
-            for attempt in range(5):
-                if attempt:  # back off BEFORE each retry, not after the last
-                    _time.sleep(0.2 * attempt)
-                parts = consumer.partitions_for_topic(topic)
-                if parts:
-                    break
+            parts = _partitions_with_retry(consumer, topic)
             if not parts:
                 parts = {
                     p for (t, p) in position if t == topic
@@ -221,14 +234,52 @@ def connect_kafka(
             offset = position.get((tp.topic, tp.partition))
             if offset is not None:
                 consumer.seek(tp, offset)
-            else:
+            elif topic_map.get(tp.topic) == REQUEST_STREAM:
+                # deliberate control-stream rewind: fresh-state
+                # incarnations re-consume Create/Update/Delete to rebuild
+                # topology (_run_kafka drops these keys on purpose)
                 consumer.seek_to_beginning(tp)
+            else:
+                # data partition the snapshot never recorded: the original
+                # consumer (subscribe mode, latest) started at the live
+                # end — replaying retained history it never consumed would
+                # train on and emit predictions for arbitrarily old data
+                consumer.seek_to_end(tp)
+            # record where this incarnation starts each partition so the
+            # NEXT snapshot covers it — without this, a partition that
+            # stays quiet between two recoveries is re-sought to the
+            # then-current end and everything in between is lost
+            if tracker is not None and (tp.topic, tp.partition) not in tracker:
+                try:
+                    tracker[(tp.topic, tp.partition)] = consumer.position(tp)
+                except Exception:
+                    pass  # best-effort, like the initial-connect seeding
     else:
         consumer = KafkaConsumer(
             *topic_map.keys(),
             bootstrap_servers=brokers,
             consumer_timeout_ms=poll_timeout_ms,
         )
+        if tracker is not None:
+            # Seed the tracker with every partition's STARTING position
+            # (its end offset now — what a latest-mode subscriber starts
+            # from): a partition idle until the first snapshot is then
+            # recorded as consumed-from-start, so recovery seeks it back
+            # there instead of hitting the untracked-partition path above.
+            # Single metadata attempt per topic: seeding is best-effort and
+            # a not-yet-created topic (broker auto-creation) must not stall
+            # startup behind the retry backoff.
+            for topic in topic_map:
+                parts = consumer.partitions_for_topic(topic)
+                if not parts:
+                    continue
+                tps = [TopicPartition(topic, p) for p in parts]
+                try:
+                    ends = consumer.end_offsets(tps)
+                except Exception:
+                    continue  # seeding is best-effort, never fatal
+                for tp, off in ends.items():
+                    tracker.setdefault((tp.topic, tp.partition), off)
     producer = KafkaProducer(bootstrap_servers=brokers)
     return (
         polling_events(consumer, topic_map, tracker=tracker),

@@ -30,7 +30,7 @@ from omldm_tpu.parallel.mesh import make_mesh
 from omldm_tpu.parallel.spmd import SPMD_PROTOCOLS, SPMDTrainer
 from omldm_tpu.runtime.databuffers import ArrayHoldout
 from omldm_tpu.runtime.spoke import PREDICT_BATCH
-from omldm_tpu.runtime.vectorizer import Vectorizer
+from omldm_tpu.runtime.vectorizer import F32_MAX, Vectorizer
 
 
 # flush remainders pad to this sub-batch instead of a full dp*B group
@@ -47,9 +47,15 @@ def spmd_engine_requested(request: Request) -> bool:
 
 def spmd_engine_supported(request: Request) -> bool:
     """The engine hosts the 6 collective protocols with device learners;
-    anything else falls back to the host plane."""
+    anything else falls back to the host plane. Sparse (padded-COO)
+    pipelines stream through the host plane too: the bridge's staging
+    buffers are dense [B, D] rows (SPMDTrainer itself trains sparse
+    batches via step_sparse — the streaming glue is the gap)."""
     protocol = request.training_configuration.protocol
     learner = request.learner.name if request.learner else ""
+    ds = request.learner.data_structure if request.learner else None
+    if ds and ds.get("sparse"):
+        return False
     return protocol in SPMD_PROTOCOLS and learner not in ("HT",)
 
 
@@ -129,7 +135,10 @@ class SPMDBridge:
                 Prediction(self.request.id, inst, float(preds[0]))
             )
             return
-        y = 0.0 if inst.target is None else float(inst.target)
+        y = (
+            0.0 if inst.target is None
+            else min(max(float(inst.target), -F32_MAX), F32_MAX)
+        )
         # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
         c = self.holdout_count % 10
         self.holdout_count += 1

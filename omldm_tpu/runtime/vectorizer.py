@@ -21,6 +21,19 @@ import numpy as np
 
 from omldm_tpu.api.data import DataInstance
 
+# float32 boundary clamp: JSON numbers are doubles, and a finite double
+# beyond float32 range would otherwise overflow to inf during batch
+# assembly (RuntimeWarning host-side, inf-poisoned params device-side).
+# The native parser applies the IDENTICAL clamp (fastparse.cpp) so the two
+# ingest paths stay bit-equal — pinned by tests/test_parser_fuzz.py.
+F32_MAX = float(np.finfo(np.float32).max)
+
+
+def clamp_f32(feats) -> np.ndarray:
+    """float64 view -> clamp to float32 finite range -> float32."""
+    a = np.asarray(feats, np.float64)
+    return np.clip(a, -F32_MAX, F32_MAX).astype(np.float32)
+
 
 @dataclasses.dataclass
 class Vectorizer:
@@ -43,7 +56,7 @@ class Vectorizer:
             if feats:
                 take = min(len(feats), dense_budget - pos)
                 if take > 0:
-                    out[pos : pos + take] = np.asarray(feats[:take], np.float32)
+                    out[pos : pos + take] = clamp_f32(feats[:take])
                     pos += take
         if self.hash_dims > 0 and inst.categorical_features:
             base = self.dim - self.hash_dims
@@ -88,7 +101,7 @@ class SparseVectorizer:
                 for v in feats:
                     if pos >= dense_budget or k >= self.max_nnz:
                         break
-                    fv = float(v)
+                    fv = min(max(float(v), -F32_MAX), F32_MAX)
                     if fv != 0.0:
                         idx[k] = pos
                         val[k] = fv

@@ -218,6 +218,25 @@ class TestRetention:
         assert mgr.latest_path().endswith(snaps[-1])
         mgr.restore()
 
+    def test_empty_latest_pointer_reads_as_no_checkpoint(self, tmp_path):
+        """A crash between pointer truncate and write must not turn into
+        IsADirectoryError deep inside recovery: an empty/ dangling pointer
+        means 'no checkpoint'."""
+        import os
+
+        job = trained_job(tmp_path, parallelism=2, n=400)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+        mgr.save(job)
+        with open(os.path.join(str(tmp_path / "ck"), "latest"), "w"):
+            pass  # truncated pointer
+        assert mgr.latest_path() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+        # dangling pointer (file pruned externally) reads the same way
+        with open(os.path.join(str(tmp_path / "ck"), "latest"), "w") as f:
+            f.write("ckpt_gone.pkl")
+        assert mgr.latest_path() is None
+
     def test_same_millisecond_saves_do_not_collide(self, tmp_path):
         job = trained_job(tmp_path, parallelism=2, n=400)
         mgr = CheckpointManager(str(tmp_path / "ck"), keep=0)

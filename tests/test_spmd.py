@@ -429,9 +429,11 @@ class TestCollectiveByteAccounting:
         assert tr.bytes_shipped() == 10 * 4 * 2 * 4  # votes only
         assert tr.collective_bytes_physical() == tr.bytes_shipped()
 
-    def test_async_physical_vs_payload(self):
-        """Async folds ride a per-step allreduce in lockstep SPMD: physical
-        bytes are per-step, application payload per accepted fold."""
+    def test_async_fold_gating_physical_tracks_payload(self):
+        """The Async fold allreduce is vote-gated (GM's pattern): steps
+        where nobody folds ship only the 1-scalar vote, so physical bytes
+        track logical folds — syncEvery x fewer param collectives than the
+        previous lockstep-every-step traffic."""
         mesh = make_mesh(dp=4, hub=1)
         tc = TrainingConfiguration(
             protocol="Asynchronous", extra={"syncEvery": 2}
@@ -449,6 +451,31 @@ class TestCollectiveByteAccounting:
         payload = tr.bytes_shipped()
         physical = tr.collective_bytes_physical()
         flat_b = 2 * tr.flat_size * 4
-        assert payload == tr.sync_count() * flat_b
-        assert physical == 8 * 4 * flat_b
-        assert physical >= payload
+        votes = 8 * 4 * 2 * 4  # 1 scalar channel x 8 steps x 4 workers
+        # all workers fold together every syncEvery steps: 4 fold rounds
+        assert tr.sync_count() == 16
+        assert payload == 16 * flat_b + votes
+        assert physical == 4 * 4 * flat_b + votes
+        # the gate saved syncEvery x vs the old lockstep per-step allreduce
+        assert physical < 8 * 4 * flat_b
+
+    def test_async_no_folds_ships_votes_only(self):
+        """With a cadence longer than the run, the param collective never
+        executes — physical traffic is the scalar vote channel alone."""
+        mesh = make_mesh(dp=4, hub=1)
+        tc = TrainingConfiguration(
+            protocol="Asynchronous", extra={"syncEvery": 1000}
+        )
+        tr = SPMDTrainer(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}),
+            dim=16, protocol="Asynchronous", mesh=mesh,
+            training_configuration=tc, batch_size=8,
+        )
+        rng = np.random.RandomState(3)
+        for _ in range(6):
+            x = rng.randn(4, 8, 16).astype(np.float32)
+            y = (x.sum(axis=2) > 0).astype(np.float32)
+            tr.step(x, y, np.ones((4, 8), np.float32), valid_count=32)
+        assert tr.sync_count() == 0
+        assert tr.collective_bytes_physical() == 6 * 4 * 2 * 4
+        assert tr.bytes_shipped() == tr.collective_bytes_physical()
