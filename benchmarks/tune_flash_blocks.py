@@ -67,7 +67,10 @@ def main():
         v = jnp.asarray(rng.randn(b, l, h, dh) * 0.1, dtype)
         q1, k1, v1 = q[:1], k[:1], v[:1]
         for bq, bk in configs:
+            # set BOTH forward and backward defaults: the train rows
+            # tune the full fwd+bwd pipeline at this tile shape
             A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K = bq, bk
+            A.DEFAULT_BWD_BLOCK_Q, A.DEFAULT_BWD_BLOCK_K = bq, bk
             name = np.dtype(dtype).name
             try:
                 t_f = chain_time(

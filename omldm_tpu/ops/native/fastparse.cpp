@@ -520,6 +520,46 @@ inline void parse_one_line(const char* p, const char* line_end, int dim,
     return;                                                       // EOS
   if (*q != '{') return;                                          // garbage
 
+  // Whole-line schema template: the dominant serialized record shape
+  // {"numericalFeatures": [ ... ], "target": N, "operation": "training"}
+  // short-circuits the general key walk (three key scans, match_key
+  // dispatch, member-separator machinery) into three memcmps around the
+  // array fast lane. Any mismatch falls through to the general walk,
+  // which re-parses the line from scratch — semantics are identical, the
+  // template is only a faster route for lines json.loads would accept.
+  {
+    static const char kHead[] = "{\"numericalFeatures\": ";
+    static const char kTgt[] = ", \"target\": ";
+    static const char kOp[] = ", \"operation\": \"training\"}";
+    const long kHeadLen = sizeof(kHead) - 1;   // 22
+    const long kTgtLen = sizeof(kTgt) - 1;     // 12
+    const long kOpLen = sizeof(kOp) - 1;       // 26
+    if (ll > kHeadLen + kTgtLen + kOpLen &&
+        memcmp(q, kHead, kHeadLen) == 0 && q[kHeadLen] == '[') {
+      Cursor t{q + kHeadLen, line_end};
+      int cnt = 0;
+      if (parse_num_array(t, xi, dim, &cnt) && cnt > 0 &&
+          line_end - t.p >= kTgtLen && memcmp(t.p, kTgt, kTgtLen) == 0) {
+        t.p += kTgtLen;
+        double tv;
+        if (parse_number(t, &tv) && line_end - t.p >= kOpLen &&
+            memcmp(t.p, kOp, kOpLen) == 0) {
+          t.p += kOpLen;
+          while (t.p < line_end && is_edge_ws(*t.p)) ++t.p;
+          if (t.p == line_end) {
+            if (cnt < dim)
+              memset(xi + cnt, 0,
+                     sizeof(float) * static_cast<size_t>(dim - cnt));
+            *yi = to_f32_clamped(tv);
+            *opi = 0;
+            *validi = 1;
+            return;
+          }
+        }
+      }
+    }
+  }
+
   Cursor c{q + 1, line_end};
   // numerical parses INLINE into xi[0..] during the walk (it always packs
   // first, DataPointParser.scala:20-33 ordering); discrete parses inline at

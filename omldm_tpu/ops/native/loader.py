@@ -12,7 +12,29 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "fastparse.cpp")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libfastparse.so")
+
+
+def _host_tag() -> str:
+    """ISA identity for the build cache: -march=native output is only
+    valid on CPUs with the same feature set, and the cache can travel
+    inside the package tree (containers, shared volumes) — a stale lib
+    would SIGILL with no catchable error."""
+    import hashlib
+    import platform
+
+    ident = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    ident += hashlib.sha1(line.encode()).hexdigest()[:12]
+                    break
+    except OSError:
+        pass
+    return ident
+
+
+_LIB_PATH = os.path.join(_BUILD_DIR, f"libfastparse_{_host_tag()}.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

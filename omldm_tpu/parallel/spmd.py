@@ -573,6 +573,27 @@ class SPMDTrainer:
             return int(syncs[:, 0].sum())
         return int(syncs[0, 0])
 
+    @staticmethod
+    def protocol_traffic_bytes(
+        protocol: str, dp: int, flat_size: int,
+        syncs_sum: int, syncs00: int, steps: int,
+    ) -> Tuple[int, int]:
+        """(sync_count, bytesShipped) from raw counters — the ONE payload
+        formula, shared with the distributed job's merged report so the
+        two accountings can never diverge."""
+        param_bytes = 2 * flat_size * 4
+        if protocol in ("Asynchronous", "SSP"):
+            sync_count = syncs_sum
+            total = syncs_sum * param_bytes
+            channels = 2 if protocol == "SSP" else 1
+            total += steps * dp * channels * 2 * 4
+        else:
+            sync_count = syncs00
+            total = syncs00 * dp * param_bytes
+        if protocol in ("GM", "FGM"):
+            total += steps * dp * 2 * 4
+        return sync_count, total
+
     def bytes_shipped(self) -> int:
         """bytesShipped (FlinkHub.scala:118-127) from CALL-SITE counters,
         not a closed-form guess: every collective site in the compiled step
@@ -592,16 +613,11 @@ class SPMDTrainer:
           even in silent rounds.
         """
         syncs = np.asarray(jax.device_get(self.state["syncs"]))
-        param_bytes = 2 * self.flat_size * 4
         steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
-        if self.protocol in ("Asynchronous", "SSP"):
-            total = int(syncs[:, 0].sum()) * param_bytes
-            channels = 2 if self.protocol == "SSP" else 1
-            total += steps * self.dp * channels * 2 * 4
-        else:
-            total = int(syncs[0, 0]) * self.dp * param_bytes
-        if self.protocol in ("GM", "FGM"):
-            total += steps * self.dp * 2 * 4
+        _, total = self.protocol_traffic_bytes(
+            self.protocol, self.dp, self.flat_size,
+            int(syncs[:, 0].sum()), int(syncs[0, 0]), steps,
+        )
         return total
 
     def collective_bytes_physical(self) -> int:

@@ -39,7 +39,6 @@ import numpy as np
 from omldm_tpu.api.requests import Request, RequestType
 from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime.databuffers import ArrayHoldout
-from omldm_tpu.runtime.vectorizer import Vectorizer
 
 CONTROL_CAP = 1 << 16  # fixed broadcast buffer: 64 KiB of request lines
 
@@ -89,7 +88,6 @@ class DistributedStreamJob:
         self.dp_local = max(self.dp_global // self.nproc, 1)
         self.trainer = None
         self.request: Optional[Request] = None
-        self.vectorizer: Optional[Vectorizer] = None
         self.test_set: Optional[ArrayHoldout] = None
         self.holdout_count = 0
         self._steps_run = 0
@@ -176,7 +174,6 @@ class DistributedStreamJob:
             batch_size=self.config.batch_size,
         )
         self.dim = dim
-        self.vectorizer = Vectorizer(dim, 0)
         self.test_set = ArrayHoldout(self.config.test_set_size, dim)
         b = self.config.batch_size
         self._stage_cap = self.dp_local * b
@@ -452,17 +449,10 @@ class DistributedStreamJob:
         loss, score = self._evaluate_global()
         syncs_sum, syncs00, steps = self._global_device_counters()
         t = self.trainer
-        param_bytes = 2 * t.flat_size * 4
-        if t.protocol in ("Asynchronous", "SSP"):
-            sync_count = syncs_sum
-            total_bytes = syncs_sum * param_bytes
-            channels = 2 if t.protocol == "SSP" else 1
-            total_bytes += steps * t.dp * channels * 2 * 4
-        else:
-            sync_count = syncs00
-            total_bytes = syncs00 * t.dp * param_bytes
-        if t.protocol in ("GM", "FGM"):
-            total_bytes += steps * t.dp * 2 * 4
+        # the ONE payload formula (shared with SPMDTrainer.bytes_shipped)
+        sync_count, total_bytes = t.protocol_traffic_bytes(
+            t.protocol, t.dp, t.flat_size, syncs_sum, syncs00, steps
+        )
 
         vec = np.asarray(
             [self.trainer.fitted, len(self.test_set)], np.float64
@@ -535,6 +525,12 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         with open(args.requests) as f:
             lines = [l.strip() for l in f if l.strip()]
     job.sync_requests(lines)
+    if job.trainer is None:
+        raise SystemExit(
+            "no pipeline deployed: the requests file must contain at least "
+            "one Create/Update with dataStructure.nFeatures "
+            f"({args.requests!r} yielded none)"
+        )
 
     # strided partition of the stream: row i belongs to process i % nproc
     from omldm_tpu.runtime.fast_ingest import iter_file_batches

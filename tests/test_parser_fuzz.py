@@ -21,7 +21,10 @@ DIM = 8
 
 
 def reference_rows(block: bytes):
-    """What the pure-Python path produces for a byte block."""
+    """What the pure-Python path produces for a byte block (including the
+    float32-range clamp both production paths apply to targets)."""
+    from omldm_tpu.runtime.vectorizer import F32_MAX
+
     vec = Vectorizer(DIM, 0)
     xs, ys, ops = [], [], []
     for line in block.split(b"\n"):
@@ -29,7 +32,10 @@ def reference_rows(block: bytes):
         if inst is None:
             continue
         xs.append(vec.vectorize(inst))
-        ys.append(0.0 if inst.target is None else inst.target)
+        ys.append(
+            0.0 if inst.target is None
+            else min(max(float(inst.target), -F32_MAX), F32_MAX)
+        )
         ops.append(1 if inst.operation == FORECASTING else 0)
     if not xs:
         return (
@@ -130,6 +136,12 @@ def make_lines(rng, n):
         '{"numericalFeatures": [1.0], "id": 1e1234567, "target": 1.0}',
         # overflow in FEATURES: is_valid rejects non-finite -> drop
         '{"numericalFeatures": [1e999], "target": 1.0}',
+        # finite-but-beyond-float32 magnitudes: KEPT, clamped to +/-FLT_MAX
+        # identically by the C parser and the Python boundary (no inf may
+        # reach device state)
+        '{"numericalFeatures": [1e308, -4e38], "target": 1e308}',
+        '{"numericalFeatures": [3.5e38], "target": -1e40}',
+        '{"numericalFeatures": [1.0], "target": 4.1e38}',
         # operation: exact spelling, last key wins, non-strings drop
         '{"numericalFeatures": [1.0], "operation": "forecaster"}',  # drop
         '{"numericalFeatures": [1.0], "operation": "forecasting"}',  # keep
@@ -151,6 +163,48 @@ def make_lines(rng, n):
 def test_fuzzed_blocks_match_python_codec(seed):
     rng = np.random.RandomState(seed)
     block = ("\n".join(make_lines(rng, 300)) + "\n").encode()
+    px, py, pop = packed_rows(block)
+    rx, ry, rop = reference_rows(block)
+    assert px.shape == rx.shape
+    np.testing.assert_allclose(px, rx, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(py, ry, rtol=1e-6, atol=0)
+    np.testing.assert_array_equal(pop, rop)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_template_shape_mutations_match_python_codec(seed):
+    """The whole-line schema-template fast path (fastparse.cpp) must agree
+    with the general walk AND the Python codec on near-misses of its exact
+    shape: every mutation must fall through to identical semantics."""
+    rng = np.random.RandomState(1000 + seed)
+    base = (
+        '{"numericalFeatures": [%s], "target": %s, '
+        '"operation": "training"}'
+    )
+    lines = []
+    for _ in range(200):
+        vals = ", ".join(
+            "%.6f" % v for v in rng.randn(rng.randint(1, 8))
+        )
+        line = base % (vals, "%.1f" % rng.rand())
+        r = rng.rand()
+        if r < 0.5:
+            lines.append(line)  # exact template shape
+        elif r < 0.7:  # single-byte mutation anywhere
+            i = rng.randint(len(line))
+            line = line[:i] + chr(rng.randint(32, 127)) + line[i + 1 :]
+            lines.append(line)
+        elif r < 0.8:  # truncation
+            lines.append(line[: rng.randint(1, len(line))])
+        elif r < 0.9:  # trailing junk / whitespace
+            lines.append(line + rng.choice([" ", "\t", " x", "\x0c", "}"]))
+        else:  # near-miss keys and values
+            lines.append(
+                line.replace("training", rng.choice(
+                    ["Training", "training ", "train", "forecasting"]
+                ))
+            )
+    block = ("\n".join(lines) + "\n").encode()
     px, py, pop = packed_rows(block)
     rx, ry, rop = reference_rows(block)
     assert px.shape == rx.shape
