@@ -759,6 +759,7 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
     corrected = n_records / max(t_host, t_device)
     os.unlink(tmp.name)
     return "e2e_json_to_params", corrected, {
+        "basis": "e2e stream-fed (tunnel-corrected)",
         "records": n_records,
         "stream_mb": round(n_bytes / 1e6, 1),
         "raw_examples_per_sec": round(n_records / t_raw, 1),

@@ -154,21 +154,15 @@ class CheckpointManager:
         bridges = {}
         for net_id, bridge in job.spmd_bridges.items():
             t = bridge.trainer
-            test_x, test_y = bridge.test_set.arrays()
             bridges[net_id] = {
                 "mesh": (t.dp, t.hub),
                 "fleet": _to_host(t.state),
                 "fitted": t.fitted,
                 "steps": t._steps_host,
                 "holdout_count": bridge.holdout_count,
-                "test_x": test_x.copy(),
-                "test_y": test_y.copy(),
-                "stage_x": np.asarray(
-                    bridge._stage_x[: bridge._stage_n], np.float32
-                ).copy(),
-                "stage_y": np.asarray(
-                    bridge._stage_y[: bridge._stage_n], np.float32
-                ).copy(),
+                # holdout + staged rows come from the bridge so the sparse
+                # variant can snapshot its COO buffers
+                **bridge.snapshot_buffers(),
             }
         snapshot = {
             "config": dataclasses.asdict(job.config),
@@ -425,10 +419,7 @@ class CheckpointManager:
         t._fitted_host = bd["fitted"]
         t._steps_host = bd["steps"]
         bridge.holdout_count = bd["holdout_count"]
-        if bd["test_x"].shape[0]:
-            bridge.test_set.append_many(bd["test_x"], bd["test_y"])
-        if bd["stage_x"].shape[0]:
-            bridge._stage_rows(bd["stage_x"], bd["stage_y"])
+        bridge.restore_buffers(bd)
 
     def _restore_network(self, job, snapshot, net_id: int):
         saved = [

@@ -547,10 +547,25 @@ class SPMDTrainer:
         counts only consumed rows)."""
         return np.asarray(jax.device_get(self.state["accepted"]))[:, 0] > 0.0
 
+    def release_stragglers(self) -> None:
+        """Termination-time SSP release — the collective analogue of the
+        host plane's SSPParameterServer.on_terminate: lift every worker's
+        clock to the fleet max so the staleness bound stops refusing final
+        drains. Needed when a worker's data partition runs dry (its clock
+        can never advance on zero-mask batches, which would pin the bound
+        and livelock peers' drains — possible in the multi-process
+        deployment where rows cannot be re-striped across processes)."""
+        new_clock = jax.jit(
+            lambda c: jnp.full_like(c, c.max()),
+            out_shardings=NamedSharding(self.mesh, P("dp", "hub")),
+        )(self.state["clock"])
+        self.state = {**self.state, "clock": new_clock}
+
     def note_requeued(self, n_rows: int) -> None:
         """Correct the fitted counter for rows a step refused (the host
         counted them optimistically when it issued the step)."""
         self._fitted_host -= int(n_rows)
+        self.requeued_rows = getattr(self, "requeued_rows", 0) + int(n_rows)
 
     def curve_slice(self) -> List[Tuple[float, int]]:
         fresh = self._curve
@@ -704,16 +719,24 @@ class SPMDTrainer:
             self._serve_cache = (jax.jit(predict_fn), jax.jit(eval_fn))
         return self._serve_cache
 
+    @staticmethod
+    def _as_device(x):
+        """Dense [B, D] arrays and padded-COO (idx, val) tuples both pass
+        through the serve programs."""
+        if isinstance(x, tuple):
+            return tuple(jnp.asarray(a) for a in x)
+        return jnp.asarray(x)
+
     def predict(self, x) -> np.ndarray:
         """Serve with the worker-0 model (post-sync replicas agree):
         transform through its preprocessor state, then learner.predict."""
         predict_fn, _ = self._serve_fns()
-        return np.asarray(predict_fn(self.state, jnp.asarray(x)))
+        return np.asarray(predict_fn(self.state, self._as_device(x)))
 
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """Loss/score of the worker-0 model on a host-side holdout set."""
         _, eval_fn = self._serve_fns()
         loss, score = eval_fn(
-            self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
+            self.state, self._as_device(x), jnp.asarray(y), jnp.asarray(mask)
         )
         return float(loss), float(score)

@@ -134,3 +134,81 @@ class ArrayHoldout:
     def clear(self) -> None:
         self._n = 0
         self._head = 0
+
+
+class SparseHoldout:
+    """Padded-COO twin of :class:`ArrayHoldout`: a bounded FIFO of
+    ((idx[K], val[K]), y) rows with the same evict-oldest /
+    evicted-points-re-enter-training contract (FlinkSpoke.scala:94-104)."""
+
+    def __init__(self, max_size: int, max_nnz: int):
+        self.max_size = max_size
+        self.max_nnz = max_nnz
+        self._idx = np.zeros((max_size, max_nnz), np.int32)
+        self._val = np.zeros((max_size, max_nnz), np.float32)
+        self._y = np.zeros((max_size,), np.float32)
+        self._n = 0
+        self._head = 0  # oldest element
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def is_empty(self) -> bool:
+        return self._n == 0
+
+    def append_many(
+        self, idxs: np.ndarray, vals: np.ndarray, ys: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """FIFO-append a block of rows; returns (ev_idx, ev_val, ev_y,
+        evictor_src) with the same semantics as ArrayHoldout.append_many."""
+        out_i: List[np.ndarray] = []
+        out_v: List[np.ndarray] = []
+        out_y: List[np.ndarray] = []
+        out_src: List[np.ndarray] = []
+        cap = self.max_size
+        for s in range(0, idxs.shape[0], cap):
+            ci = idxs[s : s + cap]
+            cv = vals[s : s + cap]
+            cy = ys[s : s + cap]
+            k = ci.shape[0]
+            fill = min(cap - self._n, k)
+            if fill > 0:
+                pos = (self._head + self._n + np.arange(fill)) % cap
+                self._idx[pos] = ci[:fill]
+                self._val[pos] = cv[:fill]
+                self._y[pos] = cy[:fill]
+                self._n += fill
+            k2 = k - fill
+            if k2 > 0:
+                pos = (self._head + np.arange(k2)) % cap
+                out_i.append(self._idx[pos].copy())
+                out_v.append(self._val[pos].copy())
+                out_y.append(self._y[pos].copy())
+                out_src.append(np.arange(s + fill, s + k))
+                self._idx[pos] = ci[fill:]
+                self._val[pos] = cv[fill:]
+                self._y[pos] = cy[fill:]
+                self._head = (self._head + k2) % cap
+        if not out_i:
+            kz = self.max_nnz
+            return (
+                np.zeros((0, kz), np.int32),
+                np.zeros((0, kz), np.float32),
+                np.zeros((0,), np.float32),
+                np.zeros((0,), np.int64),
+            )
+        return (
+            np.concatenate(out_i),
+            np.concatenate(out_v),
+            np.concatenate(out_y),
+            np.concatenate(out_src),
+        )
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        order = (self._head + np.arange(self._n)) % self.max_size
+        return self._idx[order], self._val[order], self._y[order]
+
+    def clear(self) -> None:
+        self._n = 0
+        self._head = 0

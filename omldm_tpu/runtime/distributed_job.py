@@ -93,6 +93,7 @@ class DistributedStreamJob:
         self._steps_run = 0
         self._eval_jit = None
         self._predict_jit = None
+        self._accepted_jit = None
 
     def _fetch_replicated(self, arr) -> np.ndarray:
         """Host copy of a REPLICATED global array: read the local shard
@@ -265,6 +266,7 @@ class DistributedStreamJob:
             else np.zeros((0,), np.float32)
         )
         self._pend_x, self._pend_y = [], []
+        requeued = []  # (x, y) blocks refused by the SSP bound this pump
         done = 0
         for _ in range(rounds):
             rows = min(cap, buf_x.shape[0] - done)
@@ -287,15 +289,55 @@ class DistributedStreamJob:
             )
             self.trainer.step(x_d, y_d, m_d, valid_count=max(rows, 0))
             self._steps_run += 1
-        if done < buf_x.shape[0]:  # carry the un-stepped tail
-            self._pend_x = [buf_x[done:]]
-            self._pend_y = [buf_y[done:]]
-            self._pend_n = buf_x.shape[0] - done
-        else:
-            self._pend_n = 0
+            if self.trainer.protocol == "SSP":
+                self._requeue_refused(
+                    x.reshape(self.dp_local, b, self.dim),
+                    y.reshape(self.dp_local, b),
+                    mask.reshape(self.dp_local, b),
+                    requeued,
+                )
+        # rebuild the pending buffer from the un-stepped tail PLUS any
+        # SSP-refused rows collected during the loop (overwriting with the
+        # tail alone would silently drop the requeued rows)
+        self._pend_x = [buf_x[done:]] if done < buf_x.shape[0] else []
+        self._pend_y = [buf_y[done:]] if done < buf_x.shape[0] else []
+        self._pend_n = max(buf_x.shape[0] - done, 0)
+        for rx, ry in requeued:
+            self._pend_x.append(rx)
+            self._pend_y.append(ry)
+            self._pend_n += rx.shape[0]
         # serve buffered forecasts at the same synchronized point (their
         # rounds are agreed collectively too)
         self._pump_forecasts()
+
+    def _requeue_refused(self, xg, yg, mg, requeued) -> None:
+        """SSP pacing across processes: the device refuses batches of
+        workers past the staleness bound (state untouched, accepted=0);
+        each process collects ITS OWN refused rows into ``requeued`` (the
+        caller merges them back into the pending buffer after the round
+        loop) and corrects the fitted counter — the multi-process form of
+        the SPMD bridge's host-driven requeue."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._accepted_jit is None:
+            rep = NamedSharding(self.mesh, P())
+            self._accepted_jit = jax.jit(
+                lambda s: s["accepted"][:, 0] > 0.0, out_shardings=rep
+            )
+        acc = self._fetch_replicated(self._accepted_jit(self.trainer.state))
+        lo = self.pid * self.dp_local
+        mine = acc[lo : lo + self.dp_local]
+        for w in np.nonzero(~mine)[0]:
+            rows = mg[w] > 0.0
+            k = int(rows.sum())
+            if k == 0:
+                continue
+            self.trainer.note_requeued(k)
+            requeued.append((
+                np.asarray(xg[w][rows], np.float32),
+                np.asarray(yg[w][rows], np.float32),
+            ))
 
     def handle_forecast_rows(self, x: np.ndarray) -> None:
         """Buffer forecast rows from this partition; predictions are
@@ -358,7 +400,28 @@ class DistributedStreamJob:
             done += max(rows, 0)
 
     def flush(self) -> None:
+        """Drain, including SSP-requeued rows: repeated final pumps are
+        guaranteed progress under balanced partitions (the bound refuses
+        only workers ahead of the slowest, and every process keeps
+        feeding its slowest workers); a livelock guard backstops
+        pathological streams."""
         self.pump(final=True)
+        guard = 0
+        while self._agree_rounds(1 if self._pend_n else 0):
+            before = self._pend_n
+            self.pump(final=True)
+            progressed = 1 if self._pend_n < before else 0
+            if not self._agree_rounds(progressed):
+                # NOBODY advanced: a dried-up partition pins the staleness
+                # bound (its worker's clock cannot move) — apply the
+                # termination-time release, exactly the host plane's
+                # SSPParameterServer.on_terminate semantics
+                self.trainer.release_stragglers()
+            guard += 1
+            if guard > 1000:
+                raise RuntimeError(
+                    "SSP drain made no progress requeuing refused rows"
+                )
         self._pump_forecasts()
 
     # --- reporting ---
@@ -479,6 +542,9 @@ class DistributedStreamJob:
             "bytesShipped": int(total_bytes),
             "syncCount": int(sync_count),
             "steps": self._steps_run,
+            # LOCAL count (process 0's workers): >0 proves the SSP requeue
+            # path executed in this run
+            "requeuedLocal": getattr(self.trainer, "requeued_rows", 0),
         }
 
 

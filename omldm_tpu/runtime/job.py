@@ -277,7 +277,7 @@ class StreamJob:
         trainingConfiguration sets {"engine": "spmd"} (and a supported
         protocol/learner) deploys on the SPMD collective engine instead."""
         from omldm_tpu.runtime.spmd_bridge import (
-            SPMDBridge,
+            make_spmd_bridge,
             spmd_engine_requested,
             spmd_engine_supported,
         )
@@ -294,7 +294,7 @@ class StreamJob:
                     spoke.handle_request(delete, 0)
         self._dims[request.id] = dim
         if use_spmd:
-            self.spmd_bridges[request.id] = SPMDBridge(
+            self.spmd_bridges[request.id] = make_spmd_bridge(
                 request, dim, self.config,
                 self._emit_prediction, self._route_response_fragment,
             )

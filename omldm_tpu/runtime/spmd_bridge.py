@@ -48,15 +48,18 @@ def spmd_engine_requested(request: Request) -> bool:
 def spmd_engine_supported(request: Request) -> bool:
     """The engine hosts the 6 collective protocols with device learners;
     anything else falls back to the host plane. Sparse (padded-COO)
-    pipelines stream through the host plane too: the bridge's staging
-    buffers are dense [B, D] rows (SPMDTrainer itself trains sparse
-    batches via step_sparse — the streaming glue is the gap)."""
+    pipelines deploy on :class:`SparseSPMDBridge`."""
     protocol = request.training_configuration.protocol
     learner = request.learner.name if request.learner else ""
-    ds = request.learner.data_structure if request.learner else None
-    if ds and ds.get("sparse"):
-        return False
     return protocol in SPMD_PROTOCOLS and learner not in ("HT",)
+
+
+def make_spmd_bridge(request: Request, dim, config, emit_prediction,
+                     emit_response) -> "SPMDBridge":
+    """Bridge factory: padded-COO pipelines get the sparse variant."""
+    ds = request.learner.data_structure if request.learner else None
+    cls = SparseSPMDBridge if (ds and ds.get("sparse")) else SPMDBridge
+    return cls(request, dim, config, emit_prediction, emit_response)
 
 
 class SPMDBridge:
@@ -330,6 +333,28 @@ class SPMDBridge:
                     "SSP flush made no progress draining refused rows"
                 )
 
+    # --- checkpoint buffer snapshot (polymorphic: sparse overrides) ---
+
+    def snapshot_buffers(self) -> dict:
+        """Holdout + staged rows for a job checkpoint."""
+        test_x, test_y = self.test_set.arrays()
+        return {
+            "test_x": test_x.copy(),
+            "test_y": test_y.copy(),
+            "stage_x": np.asarray(
+                self._stage_x[: self._stage_n], np.float32
+            ).copy(),
+            "stage_y": np.asarray(
+                self._stage_y[: self._stage_n], np.float32
+            ).copy(),
+        }
+
+    def restore_buffers(self, bd: dict) -> None:
+        if bd["test_x"].shape[0]:
+            self.test_set.append_many(bd["test_x"], bd["test_y"])
+        if bd["stage_x"].shape[0]:
+            self._stage_rows(bd["stage_x"], bd["stage_y"])
+
     # --- fused file ingest (C parse -> holdout -> stage, zero numpy) ---
 
     def supports_fused_ingest(self) -> bool:
@@ -529,3 +554,258 @@ class SPMDBridge:
             mean_buffer_size=float(self._stage_n),
             score=score,
         )
+
+
+class SparseSPMDBridge(SPMDBridge):
+    """Padded-COO pipeline on the collective engine: the model vector stays
+    dense and hub-sharded on the mesh, each record ships only its K active
+    features ((idx[K], val[K]) — the SparseVector input type of the
+    reference's parse path, DataPointParser.scala:4,20-47), and protocol
+    sync is the same XLA collective as the dense bridge. Streaming contract
+    identical: 8-of-10 holdout, forecasts at stream position, bucketed
+    query responses, termination fragments, byte-accounted statistics."""
+
+    def __init__(self, request, dim, config, emit_prediction, emit_response):
+        super().__init__(request, dim, config, emit_prediction, emit_response)
+        from omldm_tpu.runtime.databuffers import SparseHoldout
+        from omldm_tpu.runtime.vectorizer import SparseVectorizer
+
+        ds = request.learner.data_structure or {}
+        self.max_nnz = int(ds.get("maxNnz", 64))
+        hash_space = int(ds.get("hashSpace", 0))
+        self.vectorizer = SparseVectorizer(dim, hash_space, self.max_nnz)
+        self.test_set = SparseHoldout(config.test_set_size, self.max_nnz)
+        # COO staging: one [dp, B] group per launch (no dense chaining)
+        self.chain = 1
+        self._stage_cap = self.dp * config.batch_size
+        self._stage_i = np.zeros((self._stage_cap, self.max_nnz), np.int32)
+        self._stage_v = np.zeros((self._stage_cap, self.max_nnz), np.float32)
+        self._stage_y = np.zeros((self._stage_cap,), np.float32)
+        self._stage_x = self._stage_v  # base-class size probes only
+        self._stage_n = 0
+
+    def supports_fused_ingest(self) -> bool:
+        return False  # the C parser packs dense rows only
+
+    # --- data path ---
+
+    def handle_data(self, inst: DataInstance) -> None:
+        idx, val = self.vectorizer.vectorize(inst)
+        if inst.operation == FORECASTING:
+            self._emit_forecast(idx, val, inst)
+            return
+        y = (
+            0.0 if inst.target is None
+            else min(max(float(inst.target), -F32_MAX), F32_MAX)
+        )
+        self._holdout_then_stage(
+            idx[None, :], val[None, :], np.asarray([y], np.float32)
+        )
+
+    def _emit_forecast(self, idx, val, inst: DataInstance) -> None:
+        bi = np.zeros((PREDICT_BATCH, self.max_nnz), np.int32)
+        bv = np.zeros((PREDICT_BATCH, self.max_nnz), np.float32)
+        bi[0] = idx
+        bv[0] = val
+        preds = self.trainer.predict((bi, bv))
+        self._emit_prediction(
+            Prediction(self.request.id, inst, float(preds[0]))
+        )
+
+    def handle_batch(self, x, y, op) -> None:
+        """Dense packed rows (the C ingest path) re-enter as COO — rare for
+        sparse jobs (the CLI routes sparse streams per-record), but a mixed
+        feed must behave identically to per-record delivery."""
+        from omldm_tpu.runtime.spoke import Spoke
+
+        n = x.shape[0]
+        if n == 0:
+            return
+        f_idx = np.nonzero(op != 0)[0]
+        prev = 0
+        for f in f_idx:
+            f = int(f)
+            if f > prev:
+                si, sv = Spoke._dense_rows_to_coo(x[prev:f], self.max_nnz)
+                self._train_sparse_rows(si, sv, y[prev:f])
+            si, sv = Spoke._dense_rows_to_coo(x[f : f + 1], self.max_nnz)
+            inst = DataInstance(
+                numerical_features=x[f].tolist(), operation=FORECASTING
+            )
+            self._emit_forecast(si[0], sv[0], inst)
+            prev = f + 1
+        if prev < n:
+            si, sv = Spoke._dense_rows_to_coo(x[prev:], self.max_nnz)
+            self._train_sparse_rows(si, sv, y[prev:])
+
+    def _train_sparse_rows(self, idx, val, y) -> None:
+        y = np.clip(np.asarray(y, np.float64), -F32_MAX, F32_MAX).astype(
+            np.float32
+        )
+        self._holdout_then_stage(idx, val, y)
+
+    def _holdout_then_stage(self, idx, val, y) -> None:
+        """8-of-10 holdout cycle with evicted rows re-entering at the
+        evicting row's stream position (exact dense-bridge semantics)."""
+        n = idx.shape[0]
+        if n == 0:
+            return
+        if self.config.test:
+            c = (self.holdout_count + np.arange(n)) % 10
+            self.holdout_count += n
+            test_mask = c >= 8
+            keep = np.nonzero(~test_mask)[0]
+            t_idx = np.nonzero(test_mask)[0]
+            ev_i, ev_v, ev_y, ev_src = self.test_set.append_many(
+                idx[t_idx], val[t_idx], y[t_idx]
+            )
+            if ev_src.size:
+                pos = np.concatenate([keep, t_idx[ev_src]])
+                order = np.argsort(pos, kind="stable")
+                idx = np.concatenate([idx[keep], ev_i])[order]
+                val = np.concatenate([val[keep], ev_v])[order]
+                y = np.concatenate([y[keep], ev_y])[order]
+            else:
+                idx, val, y = idx[keep], val[keep], y[keep]
+        else:
+            self.holdout_count += n
+        self._stage_coo(idx, val, y)
+
+    def _stage_coo(self, idx, val, y) -> None:
+        """Fill the COO stage (the sparse twin of _stage_rows); a full
+        stage launches one [dp, B] collective step and the fill resumes —
+        overflow beyond the stage capacity trains rather than truncating
+        (restore under a smaller mesh relies on this)."""
+        i = 0
+        n = idx.shape[0]
+        while i < n:
+            take = min(self._stage_cap - self._stage_n, n - i)
+            s = self._stage_n
+            self._stage_i[s : s + take] = idx[i : i + take]
+            self._stage_v[s : s + take] = val[i : i + take]
+            self._stage_y[s : s + take] = y[i : i + take]
+            self._stage_n += take
+            i += take
+            if self._stage_n >= self._stage_cap:
+                self._train_staged(full=True)
+
+    def _train_staged(self, full: bool = False) -> None:
+        n = self._stage_n
+        if n == 0:
+            return
+        b = self.config.batch_size
+        if self._paced:
+            # copy: refused batches re-enter the (reused) stage buffers
+            si = self._stage_i[:n].copy()
+            sv = self._stage_v[:n].copy()
+            sy = self._stage_y[:n].copy()
+        else:
+            si, sv, sy = (
+                self._stage_i[:n], self._stage_v[:n], self._stage_y[:n]
+            )
+        self._stage_n = 0
+        group = self.dp * b
+        done = 0
+        while n - done >= group:
+            ig = si[done : done + group].reshape(self.dp, b, self.max_nnz)
+            vg = sv[done : done + group].reshape(self.dp, b, self.max_nnz)
+            yg = sy[done : done + group].reshape(self.dp, b)
+            mg = np.ones((self.dp, b), np.float32)
+            self.trainer.step((ig, vg), yg, mg, valid_count=group)
+            self._requeue_refused_sparse(ig, vg, yg, mg)
+            done += group
+        tail_b = min(b, TAIL_BATCH)
+        tail_group = self.dp * tail_b
+        while n - done > 0:
+            rem = min(n - done, tail_group)
+            ti = np.zeros((tail_group, self.max_nnz), np.int32)
+            tv = np.zeros((tail_group, self.max_nnz), np.float32)
+            ty = np.zeros((tail_group,), np.float32)
+            tm = np.zeros((tail_group,), np.float32)
+            ti[:rem] = si[done : done + rem]
+            tv[:rem] = sv[done : done + rem]
+            ty[:rem] = sy[done : done + rem]
+            tm[:rem] = 1.0
+            # stripe rows across workers; SSP maps slots slowest-first so
+            # every tail pass is guaranteed progress (dense-bridge rule)
+            ig = np.ascontiguousarray(
+                ti.reshape(tail_b, self.dp, self.max_nnz).transpose(1, 0, 2)
+            )
+            vg = np.ascontiguousarray(
+                tv.reshape(tail_b, self.dp, self.max_nnz).transpose(1, 0, 2)
+            )
+            yg = np.ascontiguousarray(ty.reshape(tail_b, self.dp).T)
+            mg = np.ascontiguousarray(tm.reshape(tail_b, self.dp).T)
+            if self._paced:
+                order = np.argsort(self.trainer.worker_clocks(), kind="stable")
+                inv = np.empty_like(order)
+                inv[order] = np.arange(self.dp)
+                ig, vg, yg, mg = ig[inv], vg[inv], yg[inv], mg[inv]
+            self.trainer.step((ig, vg), yg, mg, valid_count=rem)
+            self._requeue_refused_sparse(ig, vg, yg, mg)
+            done += rem
+
+    def _requeue_refused_sparse(self, ig, vg, yg, mg) -> None:
+        if not self._paced:
+            return
+        acc = self.trainer.last_accepted()
+        if acc.all():
+            return
+        for w in np.nonzero(~acc)[0]:
+            rows = mg[w] > 0.0
+            k = int(rows.sum())
+            if k == 0:
+                continue
+            self.trainer.note_requeued(k)
+            # refused rows re-enter the stage directly (they already went
+            # through the holdout cycle)
+            self._stage_coo(ig[w][rows], vg[w][rows], yg[w][rows])
+
+    # --- evaluation / checkpoint buffers ---
+
+    def _evaluate(self):
+        if self.test_set.is_empty:
+            return 0.0, 0.0
+        ti, tv, ty = self.test_set.arrays()
+        cap = self.test_set.max_size
+        n = len(ty)
+        if n < cap:
+            pad = cap - n
+            ti = np.concatenate(
+                [ti, np.zeros((pad, self.max_nnz), np.int32)]
+            )
+            tv = np.concatenate(
+                [tv, np.zeros((pad, self.max_nnz), np.float32)]
+            )
+            ty = np.concatenate([ty, np.zeros((pad,), np.float32)])
+        mask = np.zeros((cap,), np.float32)
+        mask[:n] = 1.0
+        return self.trainer.evaluate((ti, tv), ty, mask)
+
+    def snapshot_buffers(self) -> dict:
+        ti, tv, ty = self.test_set.arrays()
+        return {
+            "sparse": True,
+            "test_i": ti.copy(),
+            "test_v": tv.copy(),
+            "test_yv": ty.copy(),
+            "stage_i": self._stage_i[: self._stage_n].copy(),
+            "stage_v": self._stage_v[: self._stage_n].copy(),
+            "stage_yv": self._stage_y[: self._stage_n].copy(),
+            # dense-keyed empties keep old readers from crashing
+            "test_x": np.zeros((0, 1), np.float32),
+            "test_y": np.zeros((0,), np.float32),
+            "stage_x": np.zeros((0, 1), np.float32),
+            "stage_y": np.zeros((0,), np.float32),
+        }
+
+    def restore_buffers(self, bd: dict) -> None:
+        if bd.get("test_i") is not None and bd["test_i"].shape[0]:
+            self.test_set.append_many(
+                bd["test_i"], bd["test_v"], bd["test_yv"]
+            )
+        if bd.get("stage_i") is not None and bd["stage_i"].shape[0]:
+            # through the stage filler: a snapshot taken on a larger mesh
+            # may carry more staged rows than this bridge's capacity, and
+            # the overflow must train, not crash or truncate
+            self._stage_coo(bd["stage_i"], bd["stage_v"], bd["stage_yv"])

@@ -34,7 +34,10 @@ def _write_stream(path, n=3000, dim=12, seed=0, forecast_every=0):
     with open(path, "w") as f:
         for i in range(n):
             x = np.round(rng.randn(dim), 6)
-            if forecast_every and i % forecast_every == 7:
+            # forecast slots at index 0 of each cycle: EVEN stream
+            # indices whenever forecast_every is even (partition-targeted
+            # imbalance for the SSP test)
+            if forecast_every and i % forecast_every == 0:
                 n_fore += 1
                 f.write(
                     json.dumps(
@@ -146,6 +149,28 @@ class TestDistributedStreamJob:
         # protocol traffic happened on the distributed run
         assert double["syncCount"] > 0
         assert double["bytesShipped"] > 0
+
+    def test_ssp_two_processes_conserves_rows(self, tmp_path):
+        """SSP across processes with DELIBERATELY imbalanced partitions
+        (forecasts land only in process 0's stride, starving its worker):
+        the staleness bound refuses the fast worker's batches, every
+        refused row is requeued (never dropped), and the fitted count
+        stays conserved."""
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "reqs.jsonl"
+        # forecast rows at EVEN stream indices -> all in process 0's
+        # partition (strided i % 2); its training rows lag process 1's
+        n_fore = _write_stream(str(train), n=2400, forecast_every=4)
+        assert n_fore > 0
+        create = json.loads(json.dumps(CREATE))
+        create["trainingConfiguration"] = {
+            "protocol": "SSP", "syncEvery": 1, "staleness": 1,
+        }
+        reqs.write_text(json.dumps(create) + "\n")
+        report, preds = _run_procs(tmp_path, 2, train, reqs)
+        assert report["fitted"] + report["holdout"] == 2400 - n_fore
+        assert len(preds) == n_fore
+        assert report["syncCount"] > 0
 
     def test_forecasts_served_across_processes(self, tmp_path):
         """Forecast rows in any partition produce predictions (served
