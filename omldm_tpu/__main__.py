@@ -375,6 +375,13 @@ def _try_fused_run(job: StreamJob, flags: Dict[str, str]) -> bool:
     ):
         return False
     spec = _stream_spec(flags)
+    sparse = False
+    if spec is None:
+        # sparse pipelines can't use the dense packed batcher, but they DO
+        # have a fused route (SparseSPMDBridge.ingest_file): resolve the
+        # width from a sparse Create instead
+        spec = _sparse_stream_spec(flags)
+        sparse = spec is not None
     if spec is None:
         return False
     if REQUEST_STREAM in flags:
@@ -382,15 +389,46 @@ def _try_fused_run(job: StreamJob, flags: Dict[str, str]) -> bool:
             job.process_event(stream, line)
         # consumed here either way: the fallback event route must not
         # replay them a second time. The packed fallback still needs the
-        # width the requests pinned, so stash the resolved spec.
+        # width the requests pinned, so stash the resolved spec — except
+        # for sparse jobs, whose fallback is the per-record route (the
+        # dense packed batcher cannot feed them).
         del flags[REQUEST_STREAM]
-        flags["__streamSpec__"] = f"{spec[0]},{spec[1]}"
+        if sparse:
+            # the dense packed batcher must NOT pick these jobs up on
+            # fallback (it would infer a dense width from the data);
+            # the marker sends them down the per-record route
+            flags["__sparseStream__"] = "1"
+        else:
+            flags["__streamSpec__"] = f"{spec[0]},{spec[1]}"
     job.ensure_deployed(spec[0])
     if job.fused_file_bridge() is None:
         return False  # requests stay processed; packed route resumes
     job.run_file_fused(flags[TRAINING_STREAM])
     job.terminate()
     return True
+
+
+def _sparse_stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
+    """(total feature dim, 0) from the first SPARSE Create/Update — the
+    fused sparse route needs the width up front like the packed one."""
+    from omldm_tpu.api.requests import Request, RequestType
+
+    if REQUEST_STREAM not in flags:
+        return None
+    try:
+        for _, line in file_events(flags[REQUEST_STREAM], REQUEST_STREAM):
+            req = Request.from_json(line)
+            if req is None or req.request not in (
+                RequestType.CREATE, RequestType.UPDATE
+            ):
+                continue
+            ds = req.learner.data_structure if req.learner else None
+            if ds and ds.get("sparse") and "nFeatures" in ds:
+                return int(ds["nFeatures"]), 0
+            return None
+    except OSError:
+        return None
+    return None
 
 
 def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
@@ -402,6 +440,8 @@ def _stream_spec(flags: Dict[str, str]) -> Optional[Tuple[int, int]]:
     from omldm_tpu.api.requests import Request, RequestType
     from omldm_tpu.runtime.vectorizer import Vectorizer
 
+    if "__sparseStream__" in flags:
+        return None  # sparse pipelines featurize per record (see below)
     if "__streamSpec__" in flags:  # resolved earlier by the fused route
         dim, hash_dims = flags["__streamSpec__"].split(",")
         return int(dim), int(hash_dims)

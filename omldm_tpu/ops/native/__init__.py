@@ -11,7 +11,13 @@ parser when a toolchain is unavailable.
 from omldm_tpu.ops.native.loader import (
     FastParser,
     FusedStage,
+    SparseFastParser,
     fast_parser_available,
 )
 
-__all__ = ["FastParser", "FusedStage", "fast_parser_available"]
+__all__ = [
+    "FastParser",
+    "FusedStage",
+    "SparseFastParser",
+    "fast_parser_available",
+]

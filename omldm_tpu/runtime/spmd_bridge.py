@@ -62,6 +62,34 @@ def make_spmd_bridge(request: Request, dim, config, emit_prediction,
     return cls(request, dim, config, emit_prediction, emit_response)
 
 
+def _line_aligned_chunks(path: str, chunk_bytes: int):
+    """Yield (buf, stop) line-aligned regions of a JSON-lines file from one
+    reusable read buffer (readinto + carried partial line; grows when a
+    single line exceeds the buffer). Shared by the dense and sparse bulk
+    ingest routes so the subtle carry logic exists once."""
+    buf = bytearray(chunk_bytes)
+    carry = 0
+    with open(path, "rb") as f:
+        while True:
+            if carry >= len(buf):  # one line longer than the buffer
+                buf.extend(bytes(len(buf)))
+            n = f.readinto(memoryview(buf)[carry:])
+            if not n:
+                break
+            end = carry + n
+            cut = buf.rfind(b"\n", 0, end)
+            if cut < 0:
+                carry = end
+                continue
+            yield buf, cut + 1
+            carry = end - (cut + 1)
+            if carry:
+                buf[:carry] = buf[cut + 1 : end]
+        if carry:
+            buf[carry : carry + 1] = b"\n"
+            yield buf, carry + 1
+
+
 class SPMDBridge:
     """One pipeline, streaming in, trained across the device mesh."""
 
@@ -395,29 +423,10 @@ class SPMDBridge:
         Reference counterpart: the whole-job per-record hot loop
         Job.scala:42-70 -> FlinkSpoke.scala:92-107."""
         fs = self._fused_stage()
-        buf = bytearray(chunk_bytes)
-        carry = 0
-        with open(path, "rb") as f:
-            while True:
-                if carry >= len(buf):  # one line longer than the buffer
-                    buf.extend(bytes(len(buf)))
-                n = f.readinto(memoryview(buf)[carry:])
-                if not n:
-                    break
-                end = carry + n
-                cut = buf.rfind(b"\n", 0, end)
-                if cut < 0:
-                    carry = end
-                    continue
-                self._fused_consume(fs, buf, 0, cut + 1)
-                if on_chunk is not None:
-                    on_chunk()
-                carry = end - (cut + 1)
-                if carry:
-                    buf[:carry] = buf[cut + 1 : end]
-            if carry:
-                buf[carry : carry + 1] = b"\n"
-                self._fused_consume(fs, buf, 0, carry + 1)
+        for buf, stop in _line_aligned_chunks(path, chunk_bytes):
+            self._fused_consume(fs, buf, 0, stop)
+            if on_chunk is not None:
+                on_chunk()
 
     def _fused_consume(self, fs, buf: bytearray, start: int, stop: int) -> None:
         """Drive the C loop over ``buf[start:stop]`` (whole lines), handing
@@ -585,7 +594,11 @@ class SparseSPMDBridge(SPMDBridge):
         self._stage_n = 0
 
     def supports_fused_ingest(self) -> bool:
-        return False  # the C parser packs dense rows only
+        """The sparse bridge has its own C bulk route (ingest_file below:
+        padded-COO packing with in-C categorical hashing)."""
+        from omldm_tpu.ops.native import fast_parser_available
+
+        return fast_parser_available()
 
     # --- data path ---
 
@@ -809,3 +822,52 @@ class SparseSPMDBridge(SPMDBridge):
             # may carry more staged rows than this bridge's capacity, and
             # the overflow must train, not crash or truncate
             self._stage_coo(bd["stage_i"], bd["stage_v"], bd["stage_yv"])
+
+    # --- bulk file ingest via the C sparse parser ---
+
+    def ingest_file(
+        self, path: str, chunk_bytes: int = 1 << 22, on_chunk=None
+    ) -> None:
+        """Stream a JSON-lines file through the C padded-COO parser:
+        fast-schema lines pack straight into (idx, val) blocks (zlib-CRC32
+        categorical hashing in C, parity fuzz-pinned by
+        tests/test_sparse_parser.py); fallback lines, forecasts and drops
+        re-route through the per-record codec at their stream position."""
+        from omldm_tpu.ops.native import SparseFastParser
+
+        parser = SparseFastParser(
+            self.vectorizer.dim - self.vectorizer.hash_space,
+            self.vectorizer.hash_space,
+            self.max_nnz,
+        )
+        for buf, stop in _line_aligned_chunks(path, chunk_bytes):
+            # one copy (memoryview slice): the special-line handling needs
+            # real bytes for lazy line splitting anyway
+            self._consume_coo_block(parser, bytes(memoryview(buf)[:stop]))
+            if on_chunk is not None:
+                on_chunk()
+
+    def _consume_coo_block(self, parser, block: bytes) -> None:
+        idx, val, y, op, valid = parser.parse(block)
+        n = idx.shape[0]
+        if n == 0:
+            return
+        # specials (codec fallbacks, forecasts, drops) break the bulk run
+        # so ordering matches per-record delivery exactly
+        special = np.nonzero((valid != 1) | (op != 0))[0]
+        lines = block.split(b"\n") if special.size else None
+        prev = 0
+        for s in special:
+            s = int(s)
+            if s > prev:
+                self._train_sparse_rows(
+                    idx[prev:s], val[prev:s], y[prev:s]
+                )
+            inst = DataInstance.from_json(
+                lines[s].decode("utf-8", errors="replace")
+            )
+            if inst is not None:
+                self.handle_data(inst)
+            prev = s + 1
+        if prev < n:
+            self._train_sparse_rows(idx[prev:], val[prev:], y[prev:])

@@ -1,0 +1,178 @@
+"""C sparse (padded-COO) parser parity with the Python codec path.
+
+omldm_parse_lines_sparse must agree with DataInstance.from_json +
+SparseVectorizer.vectorize on keep/drop AND on the exact (idx, val, y, op)
+arrays — categoricals hash with zlib-CRC32 and the signed rule, dense
+values keep positional slots, max_nnz truncation matches, and every shape
+the C walk cannot reproduce bit-exactly (escaped category strings,
+out-of-order keys, metadata) defers to Python (valid=2) rather than
+guessing.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.api.data import FORECASTING, DataInstance
+from omldm_tpu.ops.native import fast_parser_available
+from omldm_tpu.runtime.vectorizer import F32_MAX, SparseVectorizer
+
+pytestmark = pytest.mark.skipif(
+    not fast_parser_available(), reason="native parser unavailable"
+)
+
+DENSE = 6
+HASH = 1 << 10
+DIM = DENSE + HASH
+K = 8
+
+
+def reference_rows(block: bytes):
+    vec = SparseVectorizer(DIM, HASH, K)
+    idxs, vals, ys, ops = [], [], [], []
+    for line in block.split(b"\n"):
+        inst = DataInstance.from_json(line.decode("utf-8", errors="replace"))
+        if inst is None:
+            continue
+        i, v = vec.vectorize(inst)
+        idxs.append(i)
+        vals.append(v)
+        ys.append(
+            0.0 if inst.target is None
+            else min(max(float(inst.target), -F32_MAX), F32_MAX)
+        )
+        ops.append(1 if inst.operation == FORECASTING else 0)
+    if not idxs:
+        return (
+            np.zeros((0, K), np.int32), np.zeros((0, K), np.float32),
+            np.zeros((0,), np.float32), np.zeros((0,), np.uint8),
+        )
+    return (
+        np.stack(idxs), np.stack(vals),
+        np.asarray(ys, np.float32), np.asarray(ops, np.uint8),
+    )
+
+
+def packed_rows(block: bytes):
+    from omldm_tpu.ops.native import SparseFastParser
+
+    p = SparseFastParser(DENSE, HASH, K)
+    idx, val, y, op, valid = p.parse(block)
+    vec = SparseVectorizer(DIM, HASH, K)
+    lines = block.split(b"\n")
+    out_i, out_v, out_y, out_o = [], [], [], []
+    for r in range(idx.shape[0]):
+        if valid[r] == 2:  # Python-codec fallback, like the dense batcher
+            inst = DataInstance.from_json(
+                lines[r].decode("utf-8", errors="replace")
+            )
+            if inst is None:
+                continue
+            i, v = vec.vectorize(inst)
+            out_i.append(i)
+            out_v.append(v)
+            out_y.append(
+                0.0 if inst.target is None
+                else min(max(float(inst.target), -F32_MAX), F32_MAX)
+            )
+            out_o.append(1 if inst.operation == FORECASTING else 0)
+        elif valid[r] == 1:
+            out_i.append(idx[r])
+            out_v.append(val[r])
+            out_y.append(y[r])
+            out_o.append(op[r])
+    if not out_i:
+        return (
+            np.zeros((0, K), np.int32), np.zeros((0, K), np.float32),
+            np.zeros((0,), np.float32), np.zeros((0,), np.uint8),
+        )
+    return (
+        np.stack(out_i), np.stack(out_v),
+        np.asarray(out_y, np.float32), np.asarray(out_o, np.uint8),
+    )
+
+
+def make_lines(rng, n):
+    lines = []
+    for i in range(n):
+        kind = rng.randint(0, 10)
+        num = [round(float(v), 5) for v in rng.randn(rng.randint(0, DENSE + 3))]
+        cats = [
+            rng.choice(["red", "blue", "big", "小さい", "x" * rng.randint(1, 9)])
+            for _ in range(rng.randint(0, 6))
+        ]
+        rec = {"numericalFeatures": num, "categoricalFeatures": cats}
+        if kind < 6:
+            rec["target"] = float(rng.randn())
+            rec["operation"] = "training"
+            lines.append(json.dumps(rec, ensure_ascii=False))
+        elif kind == 6:
+            rec["operation"] = "forecasting"
+            lines.append(json.dumps(rec, ensure_ascii=False))
+        elif kind == 7:  # escapes in category strings -> Python fallback
+            rec["categoricalFeatures"] = ["a\\b", "tab\there", 'q"uote']
+            rec["target"] = 1.0
+            lines.append(json.dumps(rec))
+        elif kind == 8:  # out-of-order keys / oddities
+            lines.append(rng.choice([
+                '{"categoricalFeatures": ["z"], "numericalFeatures": [1.5]}',
+                '{"discreteFeatures": [2.0], "numericalFeatures": [1.0]}',
+                '{"numericalFeatures": [0.0, 1.0], "target": 1e308}',
+                '{"numericalFeatures": [1.0], "metadata": {"a": 1}}',
+                '{"numericalFeatures": [1.0, "x"], "target": 1.0}',
+                '{"categoricalFeatures": [1.0], "target": 1.0}',
+                '{"categoricalFeatures": ["a", "b", "c", "d", "e", "f", '
+                '"g", "h", "i", "j"], "numericalFeatures": []}',
+                # PRESENT-but-zero features: is_valid keeps them (a zero
+                # COO row trains as a no-op) — validity is presence
+                '{"numericalFeatures": [0.0], "target": 1.0, '
+                '"operation": "training"}',
+                '{"numericalFeatures": [0.0, 0.00000], "target": 0.0}',
+                "EOS",
+                "garbage {",
+            ]))
+        else:  # many nonzero dense values (max_nnz truncation)
+            rec = {
+                "numericalFeatures":
+                    [round(float(v) + 1.0, 4) for v in rng.rand(DENSE + 2)],
+                "categoricalFeatures": ["a", "b", "c", "d", "e"],
+                "target": 0.0,
+                "operation": "training",
+            }
+            lines.append(json.dumps(rec))
+    return lines
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_sparse_fuzz_matches_python_codec(seed):
+    rng = np.random.RandomState(seed)
+    block = ("\n".join(make_lines(rng, 250)) + "\n").encode()
+    pi, pv, py_, po = packed_rows(block)
+    ri, rv, ry, ro = reference_rows(block)
+    assert pi.shape == ri.shape
+    np.testing.assert_array_equal(pi, ri)
+    np.testing.assert_allclose(pv, rv, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(py_, ry, rtol=1e-6, atol=0)
+    np.testing.assert_array_equal(po, ro)
+
+
+def test_crc32_hash_parity_exact():
+    """The C CRC32 must match zlib.crc32 bit-for-bit (bucket AND sign)."""
+    import zlib
+
+    block_lines = []
+    cats = ["red", "large", "café", "з", "0", "=weird=", " "]
+    for c in cats:
+        block_lines.append(json.dumps(
+            {"numericalFeatures": [], "categoricalFeatures": [c, c],
+             "target": 1.0, "operation": "training"},
+            ensure_ascii=False,
+        ))
+    block = ("\n".join(block_lines) + "\n").encode()
+    pi, pv, _, _ = packed_rows(block)
+    for row, c in zip(range(len(cats)), cats):
+        for j in range(2):
+            h = zlib.crc32(f"{j}={c}".encode())
+            assert pi[row, j] == DENSE + (h % HASH)
+            assert pv[row, j] == (1.0 if (h >> 1) % 2 == 0 else -1.0)

@@ -77,6 +77,18 @@ def _run_job(create, lines, parallelism=2, batch=32):
     return job, report
 
 
+def _run_job_events(create, lines, parallelism=2, batch=32):
+    """Per-record delivery WITHOUT termination (parity-vs-file runs)."""
+    job = StreamJob(JobConfig(
+        parallelism=parallelism, batch_size=batch, test_set_size=32,
+    ))
+    events = [(REQUEST_STREAM, json.dumps(create))] + [
+        (TRAINING_STREAM, l) for l in lines
+    ]
+    job.run(events, terminate_on_end=False)
+    return job, None
+
+
 class TestSparseSPMDBridge:
     def test_deploys_on_sparse_bridge_and_learns(self):
         job, report = _run_job(_create(), _lines(4000))
@@ -116,6 +128,45 @@ class TestSparseSPMDBridge:
         [stats] = report.statistics
         # every training row either fitted or resident in the holdout ring
         assert stats.fitted + len(bridge.test_set) == 1500
+
+    def test_bulk_coo_ingest_matches_per_record(self, tmp_path):
+        """The C padded-COO file route (SparseSPMDBridge.ingest_file) is
+        indistinguishable from per-record event delivery: same params,
+        fitted count, holdout ring, predictions — forecasts, codec
+        fallbacks and drops included."""
+        from omldm_tpu.ops.native import fast_parser_available
+
+        if not fast_parser_available():
+            pytest.skip("native parser unavailable")
+        lines = _lines(2500, forecast_every=90)
+        lines.insert(100, "not json")
+        lines.insert(700, "EOS")
+
+        job_a, _ = _run_job_events(_create(), lines)
+        [bridge_a] = job_a.spmd_bridges.values()
+
+        path = tmp_path / "train.jsonl"
+        path.write_text("\n".join(lines) + "\n")
+        job_b = StreamJob(JobConfig(
+            parallelism=2, batch_size=32, test_set_size=32,
+        ))
+        job_b.process_event(REQUEST_STREAM, json.dumps(_create()))
+        job_b.ensure_deployed(DIM)
+        assert job_b.run_file_fused(str(path)), "sparse fused route refused"
+        [bridge_b] = job_b.spmd_bridges.values()
+        bridge_a.flush()
+        bridge_b.flush()
+        np.testing.assert_allclose(
+            np.asarray(bridge_a.trainer.global_flat_params()),
+            np.asarray(bridge_b.trainer.global_flat_params()),
+            rtol=1e-6, atol=1e-6,
+        )
+        assert bridge_a.trainer.fitted == bridge_b.trainer.fitted
+        assert bridge_a.holdout_count == bridge_b.holdout_count
+        assert len(bridge_a.test_set) == len(bridge_b.test_set)
+        assert len(job_a.predictions) == len(job_b.predictions)
+        for pa, pb in zip(job_a.predictions, job_b.predictions):
+            assert pa.value == pytest.approx(pb.value, rel=1e-6)
 
     def test_checkpoint_roundtrip(self, tmp_path):
         from omldm_tpu.checkpoint import CheckpointManager
