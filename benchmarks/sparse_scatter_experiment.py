@@ -1,0 +1,198 @@
+"""Sparse scatter-add ceiling experiment (run on the real TPU chip).
+
+The sparse hot loop (benchmarks/run_benchmarks._bench_sparse) is bound by
+the scatter-add of B*K randomly-indexed updates into the dense model
+vector w[D] (D = 13 + 2^18, K = 39 — Criteo-shaped, reference
+DataPointParser.scala:4,20-47). This script measures every TPU-native
+formulation of that scatter head-to-head, one jitted chain per candidate
+(tunnel-timing rules: one program per measurement, real D2H fetch as the
+barrier, chain long enough to dwarf the ~70 ms round trip):
+
+1. xla-scatter:    w.at[idx].add(u)                  (the current engine)
+2. mxu-kron-bf16x2: scatter as ONE MXU contraction — factor the index
+   space D <= R*C as (hi, lo) = divmod(idx, C); then
+       delta[hi, lo] = sum_n u_n * e(hi_n) (x) e(lo_n)
+                     = OneHotHi[N, R]^T @ (OneHotLo[N, C] * u_n)
+   One-hot entries are exact in bf16; u is split u = hi(u) + lo(u)
+   (two bf16 addends per update, concatenated along the contraction dim)
+   so every MXU product is exact and only the f32 accumulation order
+   differs from the scatter's — the same error class as any reduction
+   reorder.
+3. mxu-kron-f32:   same contraction with f32 operands (no split).
+4. sort-segment:   sort_key_val(idx, u) + segment boundaries + cumsum
+   collapse, then scatter the collapsed updates.
+
+It prints measured updates/sec per candidate plus the roofline math: at
+D = 2^18 the dense reformulation costs 2*D FLOPs per update (x2 for the
+bf16x2 split), so N updates/sec costs N * 2^20 FLOP/s — 200M updates/sec
+(the 5M examples/sec bar at K=39) is ~210 TFLOP/s, ABOVE the chip's bf16
+peak. The scatter formulation is serialization-bound, the matmul
+formulation is MXU-peak-bound; the crossover between them is what this
+experiment locates empirically.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# persistent compile cache: 8 tunnel compiles otherwise dominate the run
+_cache = os.path.join(os.path.expanduser("~"), ".cache", "omldm_tpu", "xla")
+os.makedirs(_cache, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+D = 13 + (1 << 18)
+K = 39
+B = 4096
+N = B * K  # scattered updates per step
+
+
+def materialize(tree):
+    """Real completion barrier: fetch one scalar D2H (block_until_ready is
+    not a completion barrier for some executables on the axon tunnel)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(jnp.asarray(leaves[0]).reshape(-1)[0])
+
+
+def chain(fn, steps):
+    """steps sequential applications inside ONE jitted program."""
+
+    @jax.jit
+    def run(w, idx, u):
+        def body(carry, _):
+            w = fn(carry, idx, u)
+            return w, ()
+
+        w, _ = jax.lax.scan(body, w, None, length=steps)
+        return w
+
+    return run
+
+
+def timed(name, fn, steps, idx, u, w0):
+    run = chain(fn, steps)
+    w = run(w0, idx, u)
+    materialize(w)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w = run(w0, idx, u)
+        materialize(w)
+        best = min(best, time.perf_counter() - t0)
+    rate = steps * N / best
+    print(
+        f"{name:18s} {best:7.3f}s for {steps} steps -> "
+        f"{rate / 1e6:8.1f}M updates/s  ({rate / K / 1e6:6.2f}M ex/s at K={K})"
+    )
+    return rate
+
+
+def xla_scatter(w, idx, u):
+    return w.at[idx].add(u)
+
+
+C_LANES = 512
+R_ROWS = -(-D // C_LANES)  # 513 for D = 13 + 2^18
+D_PAD = R_ROWS * C_LANES
+
+
+def mxu_kron_bf16x2(w, idx, u):
+    hi = idx // C_LANES
+    lo = idx % C_LANES
+    a = jax.nn.one_hot(hi, R_ROWS, dtype=jnp.bfloat16)          # [N, R]
+    lo_oh = jax.nn.one_hot(lo, C_LANES, dtype=jnp.float32)      # [N, C]
+    u_hi = u.astype(jnp.bfloat16).astype(jnp.float32)
+    u_lo = u - u_hi
+    b = jnp.concatenate(
+        [
+            (lo_oh * u_hi[:, None]).astype(jnp.bfloat16),
+            (lo_oh * u_lo[:, None]).astype(jnp.bfloat16),
+        ],
+        axis=0,
+    )                                                            # [2N, C]
+    a2 = jnp.concatenate([a, a], axis=0)                         # [2N, R]
+    delta = jax.lax.dot_general(
+        a2, b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                            # [R, C]
+    return w + delta.reshape(-1)[:D] if w.shape[0] == D else w + delta.reshape(-1)
+
+
+def mxu_kron_f32(w, idx, u):
+    hi = idx // C_LANES
+    lo = idx % C_LANES
+    a = jax.nn.one_hot(hi, R_ROWS, dtype=jnp.float32)
+    b = jax.nn.one_hot(lo, C_LANES, dtype=jnp.float32) * u[:, None]
+    delta = jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return w + delta.reshape(-1)[:D] if w.shape[0] == D else w + delta.reshape(-1)
+
+
+def sort_segment(w, idx, u):
+    """Sort by index, collapse duplicate runs via cumsum differences, then
+    scatter one value per RUN (non-run positions land in a pad row). The
+    scatter still issues N updates — the question is whether duplicate-free
+    target rows let XLA's scatter run meaningfully faster."""
+    si, su = jax.lax.sort_key_val(idx, u)
+    cs = jnp.cumsum(su)
+    is_end = jnp.concatenate([si[1:] != si[:-1], jnp.ones((1,), bool)])
+    run_start = jnp.concatenate([jnp.ones((1,), bool), si[1:] != si[:-1]])
+    start_cs = jnp.concatenate([jnp.zeros((1,)), cs[:-1]])
+    # per-run total = cs[end] - cs[start - 1]; scatter both halves
+    pos = jnp.where(is_end, si, D)       # pad row D for non-ends
+    neg = jnp.where(run_start, si, D)
+    w_pad = jnp.zeros(D + 1, w.dtype)
+    acc = (
+        w_pad.at[pos].add(jnp.where(is_end, cs, 0.0))
+        .at[neg].add(-jnp.where(run_start, start_cs, 0.0))
+    )
+    return w + acc[:D]
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, D, size=(N,)).astype(np.int32))
+    u = jnp.asarray(rng.randn(N).astype(np.float32))
+    w0 = jnp.zeros((D,), jnp.float32)
+    materialize((idx, u, w0))
+
+    # numerical parity first (sum of exact products, reordered)
+    ref = np.zeros(D, np.float32)
+    np.add.at(ref, np.asarray(idx), np.asarray(u))
+    for name, fn in [
+        ("xla-scatter", xla_scatter),
+        ("mxu-kron-bf16x2", mxu_kron_bf16x2),
+        ("mxu-kron-f32", mxu_kron_f32),
+        ("sort-segment", sort_segment),
+    ]:
+        out = np.asarray(jax.jit(fn)(w0, idx, u))
+        err = np.max(np.abs(out - ref)) / max(np.max(np.abs(ref)), 1e-9)
+        print(f"parity {name:18s} max rel err {err:.2e}", flush=True)
+
+    rates = {}
+    rates["xla-scatter"] = timed("xla-scatter", xla_scatter, 64, idx, u, w0)
+    rates["mxu-kron-bf16x2"] = timed(
+        "mxu-kron-bf16x2", mxu_kron_bf16x2, 256, idx, u, w0
+    )
+    rates["mxu-kron-f32"] = timed("mxu-kron-f32", mxu_kron_f32, 64, idx, u, w0)
+    rates["sort-segment"] = timed("sort-segment", sort_segment, 64, idx, u, w0)
+
+    print("\nroofline:")
+    flop_per_upd = 2 * 2 * D_PAD / 1.0  # bf16x2: two 2*D_pad-FLOP addends
+    print(
+        f"  dense reformulation: {flop_per_upd / 1e6:.2f} MFLOP/update "
+        f"(bf16x2) -> 200M upd/s (the 5M ex/s bar) needs "
+        f"{200e6 * flop_per_upd / 1e12:.0f} TFLOP/s vs ~197 bf16 peak"
+    )
+    best = max(rates, key=rates.get)
+    print(f"  best: {best} at {rates[best]/1e6:.1f}M upd/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -153,7 +153,15 @@ def _enable_compile_cache(flags: Dict[str, str]) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    flags = parse_flags(sys.argv[1:] if argv is None else argv)
+    argv = sys.argv[1:] if argv is None else argv
+    flags = parse_flags(argv)
+    if any(k in flags for k in ("processes", "processId", "coordinator")):
+        # multi-process deployment: one entry point for both shapes
+        # (Job.scala:110-120 — the reference has exactly one main); each
+        # process runs the same command with its own --processId
+        from omldm_tpu.runtime.distributed_job import run_distributed
+
+        return run_distributed(argv)
     _ensure_backend()
     _enable_compile_cache(flags)
     job, sinks = build_job(flags)

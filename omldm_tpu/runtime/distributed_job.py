@@ -2,28 +2,44 @@
 
 Reference counterpart: the Flink job runs N parallel subtasks across a
 cluster, fed by partitioned Kafka topics (reference: README.md:21-29,
-parallelism 16 at src/main/scala/omldm/utils/DefaultJobParameters.scala:5).
-The TPU-native deployment is one PYTHON PROCESS per host, joined through
-``jax.distributed``:
+parallelism 16 at src/main/scala/omldm/utils/DefaultJobParameters.scala:5),
+and EVERY feature of the framework works in that deployment: many
+concurrent pipelines (SpokeLogic.scala:28-29 keeps a Map[Int, wrapper] per
+subtask), the full Create/Update/Query/Delete control plane
+(PipelineMap.scala:37-57 broadcast to all workers), and checkpoint/restore
+of operator state (FlinkSpoke.scala:233-334). The TPU-native deployment is
+one PYTHON PROCESS per host, joined through ``jax.distributed``:
 
-- each process owns an ingest partition (its slice of the stream — the
-  role of a Kafka partition assignment) and stages rows for its own
-  mesh shard;
-- the batch is assembled into ONE globally-sharded array with
+- each process owns an ingest partition (a strided slice of a shared file,
+  or an assigned set of Kafka partitions — the role of Flink's per-subtask
+  Kafka partition assignment, KafkaUtils.scala:11-31) and stages rows for
+  its own mesh shard;
+- each batch is assembled into ONE globally-sharded array with
   ``host_local_array`` and trained by the standard :class:`SPMDTrainer`
   step — protocol sync is the same XLA collective whether the workers
   share a host or not (ICI within a slice, DCN across);
-- the CONTROL PLANE lives on process 0: Create/Update/Delete request
-  lines are broadcast to every process over the collective fabric itself
-  (a padded uint8 array, replicated-out jit) — control messages ride the
-  same links as training traffic, no side channel;
-- statistics merge with a psum-style reduction and process 0 emits the
-  job report (the role of the reference's StatisticsOperator sink).
+- the CONTROL PLANE lives on process 0: request lines are broadcast to
+  every process over the collective fabric itself (a padded uint8 array,
+  replicated-out jit) — control messages ride the same links as training
+  traffic, no side channel. Every process hosts the same pipeline map
+  (keyed by networkId, the multi-process form of SpokeLogic.scala:28-29);
+  Create/Update deploy, Delete tears down, Query answers COLLECTIVELY
+  (the union-holdout eval and the worker-0 parameter gather are lockstep
+  programs) and process 0 emits the bucketed QueryResponse;
+- statistics merge with psum-style reductions into the reference's
+  JobStatistics schema (StatisticsOperator.scala:110-127) and process 0
+  emits the report;
+- checkpoints snapshot the SHARED fleet state once (gathered collectively,
+  written by process 0) plus each process's partition cursor and local
+  buffers, at synchronized pump points — restore resumes every process
+  from the same consistent cut (the role of Flink's checkpoint barriers +
+  FlinkSpoke.scala:233-334 operator state).
 
 Single-process every piece degrades to local behavior, so the same code
-runs a laptop test and a pod deployment. CLI:
+runs a laptop test and a pod deployment. CLI (ParameterTool-style flags,
+shared with ``python -m omldm_tpu``):
 
-    python -m omldm_tpu.runtime.distributed_job \
+    python -m omldm_tpu \
         --coordinator 127.0.0.1:9876 --processes 2 --processId 0 \
         --requests reqs.jsonl --trainingData train.jsonl \
         --performanceOut perf.jsonl
@@ -32,15 +48,25 @@ runs a laptop test and a pod deployment. CLI:
 from __future__ import annotations
 
 import json
-from typing import List, Optional, Tuple
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.api.responses import QueryResponse
+from omldm_tpu.api.stats import JobStatistics, Statistics
 from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime.control import PipelineManager
 from omldm_tpu.runtime.databuffers import ArrayHoldout
+from omldm_tpu.runtime.responses import ResponseMerger
 
 CONTROL_CAP = 1 << 16  # fixed broadcast buffer: 64 KiB of request lines
+
+# rows read from the source between synchronized pump points
+CHUNK_ROWS = 4096
 
 
 def _mesh_and_procs(coordinator, num_processes, process_id):
@@ -61,14 +87,74 @@ def _mesh_and_procs(coordinator, num_processes, process_id):
     return mesh, pid, nproc
 
 
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    _atomic_write_bytes(path, json.dumps(obj).encode("utf-8"))
+
+
+def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    # through the fsync'd writer: the checkpoint barrier orders the
+    # LATEST flip after these writes, but durability needs the fsync
+    _atomic_write_bytes(path, buf.getvalue())
+
+
+class _DistPipeline:
+    """One pipeline's state on THIS process — the per-subtask wrapper map
+    entry (SpokeLogic.scala:28-29): the shared SPMD trainer plus this
+    partition's holdout split, pending/forecast buffers and predictions."""
+
+    def __init__(self, request: Request, raw_line: str, dim: int,
+                 trainer, test_cap: int, stage_cap: int):
+        self.request = request
+        self.raw_line = raw_line  # original JSON, for checkpoint manifests
+        self.dim = dim
+        self.trainer = trainer
+        self.stage_cap = stage_cap
+        self.test_set = ArrayHoldout(test_cap, dim)
+        self.holdout_count = 0
+        self.pend_x: List[np.ndarray] = []
+        self.pend_y: List[np.ndarray] = []
+        self.pend_n = 0
+        self.fore_x: List[np.ndarray] = []
+        self.fore_n = 0
+        self.predictions: List[float] = []
+        self.steps_run = 0
+        # pump-granularity learning curve: (global mean loss of the pump's
+        # last step, cumulative GLOBAL rows staged) — the distributed form
+        # of the PS's incremental curve slices (FlinkHub.scala:101-116)
+        self.curve: List[Tuple[float, int]] = []
+        self.global_rows = 0
+        # cached per-pipeline jitted collective programs
+        self._eval_jit = None
+        self._predict_jit = None
+        self._accepted_jit = None
+        self._gather_params_jit = None
+        self._gather_state_jit = None
+        self._counters_jit = None
+
+
 class DistributedStreamJob:
-    """One streaming pipeline trained across every process's devices.
+    """Streaming pipelines trained across every process's devices.
 
     The training contract mirrors the in-process SPMD bridge: 8-of-10
     holdout split per partition (FlinkSpoke.scala:94-104 semantics, applied
     to the partition the way each Flink subtask applies it to its own
     split), staged [local_dp, B, D] micro-batches, one collective step per
-    full stage across ALL processes in lockstep."""
+    full stage across ALL processes in lockstep. Every collective-bearing
+    method must be called at synchronized points with identical arguments
+    on every process (request lines are broadcast to guarantee this)."""
 
     def __init__(
         self,
@@ -86,20 +172,69 @@ class DistributedStreamJob:
         self._jax = jax
         self.dp_global = self.mesh.shape["dp"]
         self.dp_local = max(self.dp_global // self.nproc, 1)
-        self.trainer = None
-        self.request: Optional[Request] = None
-        self.test_set: Optional[ArrayHoldout] = None
-        self.holdout_count = 0
-        self._steps_run = 0
-        self._eval_jit = None
-        self._predict_jit = None
-        self._accepted_jit = None
+        self.pipeline_manager = PipelineManager()
+        self.pipelines: Dict[int, _DistPipeline] = {}
+        self.dim: Optional[int] = None  # stream width, set by first deploy
+        self.responses: List[QueryResponse] = []
+        self.response_merger = ResponseMerger(self.responses.append)
+        self.orphan_predictions: List[Tuple[int, float]] = []
+        self.start_time = time.time()
+        self._ckpt_seq = 0
+        self._reduce_jits: Dict[Tuple[str, int], Any] = {}
+        self._loss_mean_jit = None
+
+    def _warn(self, msg: str) -> None:
+        print(f"[distributed p{self.pid}] {msg}", file=sys.stderr)
 
     def _fetch_replicated(self, arr) -> np.ndarray:
         """Host copy of a REPLICATED global array: read the local shard
         (a plain device_get would try to fetch non-addressable shards of
         the multi-process array and fail)."""
         return np.asarray(arr.addressable_shards[0].data)
+
+    # --- fabric primitives ---
+
+    def _collective_reduce(self, values: Sequence[float], op: str) -> np.ndarray:
+        """Elementwise sum/max of a small per-process float vector over the
+        fabric; returns the reduced vector (identical on every process)."""
+        vec = np.asarray(list(values), np.float64)
+        if self.nproc == 1:
+            return vec
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from omldm_tpu.parallel.multihost import host_local_array
+
+        k = vec.size
+        if op == "sum":
+            rows = np.broadcast_to(
+                vec[None, :] / self.dp_local, (self.dp_local, k)
+            ).astype(np.float64)
+        else:
+            rows = np.broadcast_to(vec[None, :], (self.dp_local, k)).astype(
+                np.float64
+            )
+        arr = host_local_array(rows, self.mesh, P("dp"))
+        fn = self._reduce_jits.get((op, k))
+        if fn is None:
+            rep = NamedSharding(self.mesh, P())
+            reduce = (lambda a: a.sum(axis=0)) if op == "sum" else (
+                lambda a: a.max(axis=0)
+            )
+            fn = jax.jit(reduce, out_shardings=rep)
+            self._reduce_jits[(op, k)] = fn
+        return self._fetch_replicated(fn(arr))
+
+    def _agree_rounds(self, local_rounds: int) -> int:
+        """All processes take the MAX of their desired round counts over
+        the fabric, so every one of them enters the same number of
+        collective steps (short partitions contribute masked batches)."""
+        return int(self._collective_reduce([float(local_rounds)], "max")[0])
+
+    def barrier(self) -> None:
+        """Fabric barrier (a fetched 1-scalar collective): nobody returns
+        until every process reached this point."""
+        self._collective_reduce([0.0], "max")
 
     # --- control plane: process-0 broadcast over the fabric ---
 
@@ -141,70 +276,137 @@ class DistributedStreamJob:
         return [l for l in text.split("\n") if l]
 
     def sync_requests(self, lines: Optional[List[str]] = None) -> None:
-        """Process 0 passes its pending request lines; every process
-        deploys the same pipelines afterwards."""
+        """Process 0 passes its pending request lines; every process runs
+        the SAME control-plane transitions afterwards (the broadcast makes
+        the lines identical, so the collective programs Query/Delete/Create
+        trigger stay lockstep). The full request vocabulary is honored:
+        Create/Update deploy, Delete tears down, Query answers collectively;
+        anything invalid or unsupported is LOGGED and dropped, never
+        silently ignored (PipelineMap.scala:34,46 prints and drops)."""
         for line in self._broadcast_lines(list(lines or [])):
             request = Request.from_json(line)
             if request is None:
+                self._warn(f"dropping unparseable request line: {line[:120]!r}")
+                continue
+            err = self.pipeline_manager.validate(request)
+            if err is not None:
+                self._warn(
+                    f"rejecting {request.request.value} for pipeline "
+                    f"{request.id}: {err}"
+                )
                 continue
             if request.request in (RequestType.CREATE, RequestType.UPDATE):
-                self._deploy(request)
+                self._deploy(request, line)
+            elif request.request == RequestType.DELETE:
+                self.pipeline_manager.admit(request)
+                dropped = self.pipelines.pop(request.id, None)
+                if dropped is not None:
+                    # predictions already served belong to the output even
+                    # though the pipeline is gone (a streaming sink would
+                    # have emitted them long ago)
+                    self.orphan_predictions.extend(
+                        (request.id, v) for v in dropped.predictions
+                    )
+                self._warn(f"pipeline {request.id} deleted")
+            elif request.request == RequestType.QUERY:
+                self._answer_query(request)
 
-    def _deploy(self, request: Request) -> None:
+    def _request_dim(self, request: Request) -> Optional[int]:
+        ds = request.learner.data_structure if request.learner else None
+        if ds and "nFeatures" in ds:
+            return int(ds["nFeatures"]) + int(
+                request.training_configuration.extra.get("hashDims", 0)
+            )
+        return None
+
+    def _deploy(self, request: Request, raw_line: str) -> None:
+        """Deploy/replace one pipeline on the shared mesh. The distributed
+        runtime hosts MANY concurrent pipelines (the reference's per-subtask
+        Map[Int, wrapper], SpokeLogic.scala:28-29); all share the stream, so
+        their feature widths must agree with the stream width pinned by the
+        first deploy. Anything the collective engine cannot host (sparse
+        COO streams, host-side learners, unsupported protocols) is rejected
+        WITH a logged reason instead of dropped silently."""
         from omldm_tpu.api.requests import TrainingConfiguration
         from omldm_tpu.parallel.spmd import SPMDTrainer
 
-        ds = request.learner.data_structure if request.learner else None
-        dim = int((ds or {}).get("nFeatures", 0))
-        if dim <= 0:
-            raise ValueError(
-                "distributed deployment needs nFeatures on the Create "
-                "(the stream width must be known before partitions start)"
+        ds = (request.learner.data_structure if request.learner else None) or {}
+        if ds.get("sparse"):
+            self._warn(
+                f"rejecting pipeline {request.id}: sparse (padded-COO) "
+                "pipelines run on the single-process SPMD bridge; the "
+                "multi-process data plane stages dense rows"
             )
+            return
+        dim = self._request_dim(request)
+        if dim is None:
+            self._warn(
+                f"rejecting pipeline {request.id}: distributed deployment "
+                "needs dataStructure.nFeatures on the Create (the stream "
+                "width must be known before partitions start)"
+            )
+            return
+        if self.dim is not None and dim != self.dim:
+            self._warn(
+                f"rejecting pipeline {request.id}: feature width {dim} != "
+                f"stream width {self.dim} pinned by the first deploy"
+            )
+            return
         tc = request.training_configuration or TrainingConfiguration(
             protocol="Synchronous"
         )
-        self.request = request
-        self.trainer = SPMDTrainer(
-            request.learner,
-            request.preprocessors or (),
-            dim=dim,
-            protocol=tc.protocol,
-            mesh=self.mesh,
-            training_configuration=tc,
-            batch_size=self.config.batch_size,
-        )
+        try:
+            trainer = SPMDTrainer(
+                request.learner,
+                request.preprocessors or (),
+                dim=dim,
+                protocol=tc.protocol,
+                mesh=self.mesh,
+                training_configuration=tc,
+                batch_size=self.config.batch_size,
+            )
+        except ValueError as exc:
+            self._warn(f"rejecting pipeline {request.id}: {exc}")
+            return
+        self.pipeline_manager.admit(request)
         self.dim = dim
-        self.test_set = ArrayHoldout(self.config.test_set_size, dim)
-        b = self.config.batch_size
-        self._stage_cap = self.dp_local * b
-        self._pend_x: List[np.ndarray] = []
-        self._pend_y: List[np.ndarray] = []
-        self._pend_n = 0
-        self._fore_x: List[np.ndarray] = []
-        self._fore_n = 0
-        self.predictions: List[float] = []
+        if request.id in self.pipelines:
+            self._warn(
+                f"pipeline {request.id} replaced by "
+                f"{request.request.value} (fresh model state)"
+            )
+        self.pipelines[request.id] = _DistPipeline(
+            request, raw_line, dim, trainer,
+            self.config.test_set_size,
+            self.dp_local * self.config.batch_size,
+        )
 
     # --- data path: this process's partition only ---
 
     def handle_partition_rows(self, x: np.ndarray, y: np.ndarray) -> None:
-        """Buffer rows from THIS process's ingest partition (holdout split
-        exactly as the in-process runtime applies it per worker). Rows are
-        NOT trained here: collective steps only run inside :meth:`pump`,
-        where every process agrees on the round count first — a process
-        stepping on local buffer fullness alone could enter a collective
-        its peers never reach (lockstep deadlock)."""
-        assert self.trainer is not None, "no pipeline deployed"
+        """Buffer rows from THIS process's ingest partition for EVERY live
+        pipeline (each record reaches each pipeline, FlinkSpoke's per-key
+        fan-out), holdout-split per pipeline exactly as the in-process
+        runtime applies it per worker. Rows are NOT trained here:
+        collective steps only run inside :meth:`pump`, where every process
+        agrees on the round count first — a process stepping on local
+        buffer fullness alone could enter a collective its peers never
+        reach (lockstep deadlock)."""
         n = x.shape[0]
         if n == 0:
             return
+        for p in self.pipelines.values():
+            self._buffer_rows(p, x, y)
+
+    def _buffer_rows(self, p: _DistPipeline, x: np.ndarray, y: np.ndarray) -> None:
         if self.config.test:
-            c = (self.holdout_count + np.arange(n)) % 10
-            self.holdout_count += n
+            n = x.shape[0]
+            c = (p.holdout_count + np.arange(n)) % 10
+            p.holdout_count += n
             test_mask = c >= 8
             keep_idx = np.nonzero(~test_mask)[0]
             t_idx = np.nonzero(test_mask)[0]
-            ev_x, ev_y, ev_src = self.test_set.append_many(x[t_idx], y[t_idx])
+            ev_x, ev_y, ev_src = p.test_set.append_many(x[t_idx], y[t_idx])
             if ev_src.size:
                 pos = np.concatenate([keep_idx, t_idx[ev_src]])
                 order = np.argsort(pos, kind="stable")
@@ -213,40 +415,38 @@ class DistributedStreamJob:
             else:
                 x, y = x[keep_idx], y[keep_idx]
         else:
-            self.holdout_count += n
+            p.holdout_count += x.shape[0]
         if x.shape[0]:
-            self._pend_x.append(np.asarray(x, np.float32))
-            self._pend_y.append(np.asarray(y, np.float32))
-            self._pend_n += x.shape[0]
+            p.pend_x.append(np.asarray(x, np.float32))
+            p.pend_y.append(np.asarray(y, np.float32))
+            p.pend_n += x.shape[0]
 
-    def _agree_rounds(self, local_rounds: int) -> int:
-        """All processes take the MAX of their desired round counts over
-        the fabric, so every one of them enters the same number of
-        collective steps (short partitions contribute masked batches)."""
-        if self.nproc == 1:
-            return local_rounds
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from omldm_tpu.parallel.multihost import host_local_array
-
-        local = np.full((self.dp_local,), float(local_rounds), np.float32)
-        arr = host_local_array(local, self.mesh, P("dp"))
-        mx = jax.jit(
-            lambda a: a.max(),
-            out_shardings=NamedSharding(self.mesh, P()),
-        )(arr)
-        return int(float(self._fetch_replicated(mx)))
+    def handle_forecast_rows(self, x: np.ndarray) -> None:
+        """Buffer forecast rows from this partition for every pipeline;
+        predictions are served collectively at the next :meth:`pump` (the
+        model is sharded across processes, so serving is a lockstep
+        program like everything else)."""
+        if x.shape[0] == 0:
+            return
+        for p in self.pipelines.values():
+            p.fore_x.append(np.asarray(x, np.float32))
+            p.fore_n += x.shape[0]
 
     def pump(self, final: bool = False) -> None:
-        """Run the agreed number of lockstep collective steps over the
-        buffered rows. Call at synchronized points of the drive loop (all
-        processes pump after the same stream chunk; ``final=True`` drains
-        remainders with zero-masked padding)."""
-        cap = self._stage_cap
-        want = (
-            -(-self._pend_n // cap) if final else self._pend_n // cap
-        )
+        """Run the agreed number of lockstep collective steps per pipeline
+        over the buffered rows. Call at synchronized points of the drive
+        loop (all processes pump after the same stream chunk; ``final=True``
+        drains remainders with zero-masked padding). Pipelines are visited
+        in sorted id order so every process issues the same collective
+        sequence."""
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            self._pump_pipeline(p, final)
+            self._pump_forecasts(p)
+
+    def _pump_pipeline(self, p: _DistPipeline, final: bool) -> None:
+        cap = p.stage_cap
+        want = -(-p.pend_n // cap) if final else p.pend_n // cap
         rounds = self._agree_rounds(int(want))
         if rounds == 0:
             return
@@ -256,21 +456,23 @@ class DistributedStreamJob:
         from omldm_tpu.parallel.multihost import host_local_array
 
         buf_x = (
-            np.concatenate(self._pend_x)
-            if self._pend_x
-            else np.zeros((0, self.dim), np.float32)
+            np.concatenate(p.pend_x)
+            if p.pend_x
+            else np.zeros((0, p.dim), np.float32)
         )
         buf_y = (
-            np.concatenate(self._pend_y)
-            if self._pend_y
+            np.concatenate(p.pend_y)
+            if p.pend_y
             else np.zeros((0,), np.float32)
         )
-        self._pend_x, self._pend_y = [], []
+        p.pend_x, p.pend_y = [], []
         requeued = []  # (x, y) blocks refused by the SSP bound this pump
         done = 0
+        staged = 0
+        last_loss = None
         for _ in range(rounds):
             rows = min(cap, buf_x.shape[0] - done)
-            x = np.zeros((cap, self.dim), np.float32)
+            x = np.zeros((cap, p.dim), np.float32)
             y = np.zeros((cap,), np.float32)
             mask = np.zeros((cap,), np.float32)
             if rows > 0:
@@ -278,8 +480,9 @@ class DistributedStreamJob:
                 y[:rows] = buf_y[done : done + rows]
                 mask[:rows] = 1.0
             done += max(rows, 0)
+            staged += max(rows, 0)
             x_d = host_local_array(
-                x.reshape(self.dp_local, b, self.dim), self.mesh, P("dp")
+                x.reshape(self.dp_local, b, p.dim), self.mesh, P("dp")
             )
             y_d = host_local_array(
                 y.reshape(self.dp_local, b), self.mesh, P("dp")
@@ -287,30 +490,53 @@ class DistributedStreamJob:
             m_d = host_local_array(
                 mask.reshape(self.dp_local, b), self.mesh, P("dp")
             )
-            self.trainer.step(x_d, y_d, m_d, valid_count=max(rows, 0))
-            self._steps_run += 1
-            if self.trainer.protocol == "SSP":
+            last_loss = p.trainer.step(x_d, y_d, m_d, valid_count=max(rows, 0))
+            p.steps_run += 1
+            if p.trainer.protocol == "SSP":
                 self._requeue_refused(
-                    x.reshape(self.dp_local, b, self.dim),
+                    p,
+                    x.reshape(self.dp_local, b, p.dim),
                     y.reshape(self.dp_local, b),
                     mask.reshape(self.dp_local, b),
                     requeued,
                 )
+        # the trainer's internal curve holds lazy multi-process arrays the
+        # host cannot np.asarray; the distributed curve below replaces it
+        p.trainer._curve.clear()
         # rebuild the pending buffer from the un-stepped tail PLUS any
         # SSP-refused rows collected during the loop (overwriting with the
         # tail alone would silently drop the requeued rows)
-        self._pend_x = [buf_x[done:]] if done < buf_x.shape[0] else []
-        self._pend_y = [buf_y[done:]] if done < buf_x.shape[0] else []
-        self._pend_n = max(buf_x.shape[0] - done, 0)
+        p.pend_x = [buf_x[done:]] if done < buf_x.shape[0] else []
+        p.pend_y = [buf_y[done:]] if done < buf_x.shape[0] else []
+        p.pend_n = max(buf_x.shape[0] - done, 0)
+        requeued_rows = 0
         for rx, ry in requeued:
-            self._pend_x.append(rx)
-            self._pend_y.append(ry)
-            self._pend_n += rx.shape[0]
-        # serve buffered forecasts at the same synchronized point (their
-        # rounds are agreed collectively too)
-        self._pump_forecasts()
+            p.pend_x.append(rx)
+            p.pend_y.append(ry)
+            p.pend_n += rx.shape[0]
+            requeued_rows += rx.shape[0]
+        # one pump-granularity learning-curve point: global mean loss of
+        # the pump's last step + globally-consumed row count (two tiny
+        # collectives per pump, not per step)
+        if last_loss is not None:
+            if self._loss_mean_jit is None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P2
 
-    def _requeue_refused(self, xg, yg, mg, requeued) -> None:
+                self._loss_mean_jit = jax.jit(
+                    lambda l: l.mean(),
+                    out_shardings=NamedSharding(self.mesh, P2()),
+                )
+            loss_val = float(
+                self._fetch_replicated(self._loss_mean_jit(last_loss))
+            )
+            consumed = self._collective_reduce(
+                [float(staged - requeued_rows)], "sum"
+            )[0]
+            p.global_rows += int(consumed)
+            p.curve.append((loss_val, p.global_rows))
+
+    def _requeue_refused(self, p: _DistPipeline, xg, yg, mg, requeued) -> None:
         """SSP pacing across processes: the device refuses batches of
         workers past the staleness bound (state untouched, accepted=0);
         each process collects ITS OWN refused rows into ``requeued`` (the
@@ -320,12 +546,12 @@ class DistributedStreamJob:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if self._accepted_jit is None:
+        if p._accepted_jit is None:
             rep = NamedSharding(self.mesh, P())
-            self._accepted_jit = jax.jit(
+            p._accepted_jit = jax.jit(
                 lambda s: s["accepted"][:, 0] > 0.0, out_shardings=rep
             )
-        acc = self._fetch_replicated(self._accepted_jit(self.trainer.state))
+        acc = self._fetch_replicated(p._accepted_jit(p.trainer.state))
         lo = self.pid * self.dp_local
         mine = acc[lo : lo + self.dp_local]
         for w in np.nonzero(~mine)[0]:
@@ -333,22 +559,13 @@ class DistributedStreamJob:
             k = int(rows.sum())
             if k == 0:
                 continue
-            self.trainer.note_requeued(k)
+            p.trainer.note_requeued(k)
             requeued.append((
                 np.asarray(xg[w][rows], np.float32),
                 np.asarray(yg[w][rows], np.float32),
             ))
 
-    def handle_forecast_rows(self, x: np.ndarray) -> None:
-        """Buffer forecast rows from this partition; predictions are
-        served collectively at the next :meth:`pump` (the model is
-        sharded across processes, so serving is a lockstep program like
-        everything else)."""
-        if x.shape[0]:
-            self._fore_x.append(np.asarray(x, np.float32))
-            self._fore_n += x.shape[0]
-
-    def _pump_forecasts(self) -> None:
+    def _pump_forecasts(self, p: _DistPipeline) -> None:
         """Agreed rounds of collective predict over buffered forecast
         rows; every process appends ITS rows' predictions locally."""
         import jax
@@ -356,12 +573,12 @@ class DistributedStreamJob:
 
         from omldm_tpu.parallel.multihost import host_local_array
 
-        cap = self._stage_cap
-        rounds = self._agree_rounds(-(-self._fore_n // cap))
+        cap = p.stage_cap
+        rounds = self._agree_rounds(-(-p.fore_n // cap))
         if rounds == 0:
             return
-        if self._predict_jit is None:
-            t = self.trainer
+        if p._predict_jit is None:
+            t = p.trainer
             rep = NamedSharding(self.mesh, P())
 
             def w0(tree):
@@ -374,59 +591,138 @@ class DistributedStreamJob:
                     z = prep.transform(w0(s), z)
                 return t.learner.predict(w0(state["params"]), z)
 
-            self._predict_jit = jax.jit(predict_fn, out_shardings=rep)
+            p._predict_jit = jax.jit(predict_fn, out_shardings=rep)
         buf = (
-            np.concatenate(self._fore_x)
-            if self._fore_x
-            else np.zeros((0, self.dim), np.float32)
+            np.concatenate(p.fore_x)
+            if p.fore_x
+            else np.zeros((0, p.dim), np.float32)
         )
-        self._fore_x, self._fore_n = [], 0
+        p.fore_x, p.fore_n = [], 0
         done = 0
         for _ in range(rounds):
             rows = min(cap, buf.shape[0] - done)
-            x = np.zeros((cap, self.dim), np.float32)
+            x = np.zeros((cap, p.dim), np.float32)
             if rows > 0:
                 x[:rows] = buf[done : done + rows]
             x_d = host_local_array(
-                x.reshape(self.dp_local, -1, self.dim), self.mesh, P("dp")
+                x.reshape(self.dp_local, -1, p.dim), self.mesh, P("dp")
             )
-            preds = self._fetch_replicated(self._predict_jit(
-                self.trainer.state, x_d
+            preds = self._fetch_replicated(p._predict_jit(
+                p.trainer.state, x_d
             ))
             # the replicated output covers every process's rows; this
             # process's slice starts at pid * cap within the global batch
             mine = preds[self.pid * cap : self.pid * cap + max(rows, 0)]
-            self.predictions.extend(float(v) for v in mine)
+            p.predictions.extend(float(v) for v in mine)
             done += max(rows, 0)
 
     def flush(self) -> None:
-        """Drain, including SSP-requeued rows: repeated final pumps are
-        guaranteed progress under balanced partitions (the bound refuses
-        only workers ahead of the slowest, and every process keeps
-        feeding its slowest workers); a livelock guard backstops
+        """Drain every pipeline, including SSP-requeued rows: repeated
+        final pumps are guaranteed progress under balanced partitions (the
+        bound refuses only workers ahead of the slowest, and every process
+        keeps feeding its slowest workers); a livelock guard backstops
         pathological streams."""
         self.pump(final=True)
-        guard = 0
-        while self._agree_rounds(1 if self._pend_n else 0):
-            before = self._pend_n
-            self.pump(final=True)
-            progressed = 1 if self._pend_n < before else 0
-            if not self._agree_rounds(progressed):
-                # NOBODY advanced: a dried-up partition pins the staleness
-                # bound (its worker's clock cannot move) — apply the
-                # termination-time release, exactly the host plane's
-                # SSPParameterServer.on_terminate semantics
-                self.trainer.release_stragglers()
-            guard += 1
-            if guard > 1000:
-                raise RuntimeError(
-                    "SSP drain made no progress requeuing refused rows"
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            guard = 0
+            while self._agree_rounds(1 if p.pend_n else 0):
+                before = p.pend_n
+                self._pump_pipeline(p, final=True)
+                progressed = 1 if p.pend_n < before else 0
+                if not self._agree_rounds(progressed):
+                    # NOBODY advanced: a dried-up partition pins the
+                    # staleness bound (its worker's clock cannot move) —
+                    # apply the termination-time release, exactly the host
+                    # plane's SSPParameterServer.on_terminate semantics
+                    p.trainer.release_stragglers()
+                guard += 1
+                if guard > 1000:
+                    raise RuntimeError(
+                        "SSP drain made no progress requeuing refused rows"
+                    )
+            self._pump_forecasts(p)
+
+    # --- queries ---
+
+    def _answer_query(self, request: Request) -> None:
+        """Answer a user Query COLLECTIVELY: the union-holdout eval and the
+        worker-0 parameter gather are lockstep programs every process runs;
+        process 0 assembles the bucketed QueryResponse fragments exactly as
+        the SPMD bridge does (FlinkNetwork.scala:196-231 wire format; the
+        fleet is one logical model, so the merger expects one fragment
+        set)."""
+        import jax
+        import jax.flatten_util  # noqa: F401  (ravel_pytree inside the jit)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        p = self.pipelines.get(request.id)
+        if p is None:
+            # admitted by the gatekeeper but never deployed here (e.g. a
+            # rejected sparse Create): say so instead of dropping
+            self._warn(f"query for undeployed pipeline {request.id} dropped")
+            return
+        self._pump_pipeline(p, final=True)
+        loss, score = self._evaluate_global(p)
+        if p._gather_params_jit is None:
+            rep = NamedSharding(self.mesh, P())
+
+            def gather_fn(state):
+                w0 = jax.tree_util.tree_map(lambda l: l[0, 0], state["params"])
+                flat, _ = jax.flatten_util.ravel_pytree(w0)
+                return flat
+
+            p._gather_params_jit = jax.jit(gather_fn, out_shardings=rep)
+        flat = self._fetch_replicated(p._gather_params_jit(p.trainer.state))
+        fitted = int(self._collective_reduce(
+            [float(p.trainer.fitted)], "sum"
+        )[0])
+        if self.pid != 0:
+            return
+        rid = request.request_id if request.request_id is not None else 0
+        bucket_cap = self.config.max_param_bucket_size
+        chunks = [
+            flat[i : i + bucket_cap]
+            for i in range(0, max(flat.size, 1), bucket_cap)
+        ] or [None]
+        req = p.request
+        learner_desc = {
+            "name": req.learner.name,
+            "hyperParameters": dict(req.learner.hyper_parameters or {}),
+            "dataStructure": dict(req.learner.data_structure or {}),
+        }
+        self.response_merger.expect(rid, 1)
+        for i, chunk in enumerate(chunks):
+            learner = (
+                dict(learner_desc) if i == 0 else {"name": learner_desc["name"]}
+            )
+            if chunk is not None:
+                learner["parameters"] = {"bucketValues": chunk.tolist()}
+            self.response_merger.add_fragment(
+                QueryResponse(
+                    response_id=rid,
+                    mlp_id=req.id,
+                    bucket=i,
+                    num_buckets=len(chunks),
+                    preprocessors=[
+                        {
+                            "name": pr.name,
+                            "hyperParameters": dict(pr.hyper_parameters or {}),
+                        }
+                        for pr in (req.preprocessors or [])
+                    ] if i == 0 else None,
+                    learner=learner,
+                    protocol=req.training_configuration.protocol if i == 0 else None,
+                    data_fitted=fitted,
+                    loss=loss,
+                    score=score,
+                    source_worker=0,
                 )
-        self._pump_forecasts()
+            )
 
     # --- reporting ---
 
-    def _evaluate_global(self) -> Tuple[float, float]:
+    def _evaluate_global(self, p: _DistPipeline) -> Tuple[float, float]:
         """Loss/score of the fleet model on the UNION of every process's
         holdout set, computed as ONE collective program: each process
         contributes its padded holdout as its mesh shard, the worker-0
@@ -437,21 +733,21 @@ class DistributedStreamJob:
 
         from omldm_tpu.parallel.multihost import host_local_array
 
-        cap = self.test_set.max_size
-        xs_l = np.zeros((self.dp_local, cap, self.dim), np.float32)
+        cap = p.test_set.max_size
+        xs_l = np.zeros((self.dp_local, cap, p.dim), np.float32)
         ys_l = np.zeros((self.dp_local, cap), np.float32)
         m_l = np.zeros((self.dp_local, cap), np.float32)
-        n = len(self.test_set)
+        n = len(p.test_set)
         if n:
-            xs, ys = self.test_set.arrays()
+            xs, ys = p.test_set.arrays()
             xs_l[0, :n] = xs
             ys_l[0, :n] = ys
             m_l[0, :n] = 1.0
         x_d = host_local_array(xs_l, self.mesh, P("dp"))
         y_d = host_local_array(ys_l, self.mesh, P("dp"))
         m_d = host_local_array(m_l, self.mesh, P("dp"))
-        if self._eval_jit is None:
-            t = self.trainer
+        if p._eval_jit is None:
+            t = p.trainer
             rep = NamedSharding(self.mesh, P())
 
             def w0(tree):
@@ -470,142 +766,356 @@ class DistributedStreamJob:
                     t.learner.score(params, z, yv, mv),
                 )
 
-            self._eval_jit = jax.jit(eval_fn, out_shardings=(rep, rep))
-        loss, score = self._eval_jit(self.trainer.state, x_d, y_d, m_d)
+            p._eval_jit = jax.jit(eval_fn, out_shardings=(rep, rep))
+        loss, score = p._eval_jit(p.trainer.state, x_d, y_d, m_d)
         return (
             float(self._fetch_replicated(loss)),
             float(self._fetch_replicated(score)),
         )
 
-    def _global_device_counters(self) -> Tuple[int, int, int]:
+    def _global_device_counters(self, p: _DistPipeline) -> Tuple[int, int, int]:
         """(sum of per-worker syncs, worker-0 syncs, worker-0 steps) read
         through a replicated-output jit (the fleet state is sharded across
         processes; direct device_get cannot address remote shards)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        rep = NamedSharding(self.mesh, P())
-        f = jax.jit(
-            lambda s: (
-                s["syncs"][:, 0].sum(),
-                s["syncs"][0, 0],
-                s["step"][0, 0],
-            ),
-            out_shardings=(rep, rep, rep),
-        )
-        a, b, c = f(self.trainer.state)
+        if p._counters_jit is None:
+            rep = NamedSharding(self.mesh, P())
+            p._counters_jit = jax.jit(
+                lambda s: (
+                    s["syncs"][:, 0].sum(),
+                    s["syncs"][0, 0],
+                    s["step"][0, 0],
+                ),
+                out_shardings=(rep, rep, rep),
+            )
+        a, b, c = p._counters_jit(p.trainer.state)
         return (
             int(self._fetch_replicated(a)),
             int(self._fetch_replicated(b)),
             int(self._fetch_replicated(c)),
         )
 
-    def merged_report(self) -> Optional[dict]:
-        """Global job report: host-side counters reduced over the fabric,
-        device counters read collectively, score evaluated on the union
-        holdout; only process 0 returns it, the others get None."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from omldm_tpu.parallel.multihost import host_local_array
-
-        loss, score = self._evaluate_global()
-        syncs_sum, syncs00, steps = self._global_device_counters()
-        t = self.trainer
-        # the ONE payload formula (shared with SPMDTrainer.bytes_shipped)
+    def pipeline_statistics(self, p: _DistPipeline) -> Tuple[Statistics, int]:
+        """One pipeline's Statistics (the reference schema,
+        FlinkHub.scala:118-153) with fabric-reduced counters, plus the
+        global holdout size. COLLECTIVE: every process must call it in the
+        same order."""
+        loss, score = self._evaluate_global(p)
+        syncs_sum, syncs00, steps = self._global_device_counters(p)
+        t = p.trainer
         sync_count, total_bytes = t.protocol_traffic_bytes(
             t.protocol, t.dp, t.flat_size, syncs_sum, syncs00, steps
         )
-
-        vec = np.asarray(
-            [self.trainer.fitted, len(self.test_set)], np.float64
+        reduced = self._collective_reduce(
+            [float(t.fitted), float(len(p.test_set)), float(p.pend_n)], "sum"
         )
-        if self.nproc > 1:
-            rows = np.broadcast_to(
-                vec[None, :] / self.dp_local, (self.dp_local, vec.size)
-            ).astype(np.float64)
-            arr = host_local_array(rows, self.mesh, P("dp"))
-            tot = jax.jit(
-                lambda a: a.sum(axis=0),
-                out_shardings=NamedSharding(self.mesh, P()),
-            )(arr)
-            vec = self._fetch_replicated(tot)
+        stats = Statistics(
+            pipeline=p.request.id,
+            protocol=t.protocol,
+            models_shipped=sync_count * t.dp,
+            bytes_shipped=int(total_bytes),
+            num_of_blocks=sync_count,
+            fitted=int(round(reduced[0])),
+            learning_curve=[l for l, _ in p.curve],
+            lcx=[r for _, r in p.curve],
+            mean_buffer_size=float(reduced[2]) / self.nproc,
+            score=score,
+        )
+        return stats, int(round(reduced[1]))
+
+    def merged_report(self) -> Optional[dict]:
+        """Global job report in the reference's JobStatistics schema
+        (StatisticsOperator.scala:110-127): one Statistics entry per live
+        pipeline, counters reduced over the fabric, score evaluated on the
+        union holdout. COLLECTIVE — every process calls it; only process 0
+        returns the dict (with deployment extras: process count, global
+        holdout sizes, local SSP-requeue proof), the others get None."""
+        entries = []
+        holdout = {}
+        requeued_local = 0
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            stats, hold = self.pipeline_statistics(p)
+            entries.append(stats)
+            holdout[str(net_id)] = hold
+            requeued_local += getattr(p.trainer, "requeued_rows", 0)
         if self.pid != 0:
             return None
-        return {
-            "processes": self.nproc,
-            "parallelism": self.dp_global,
-            "fitted": int(round(vec[0])),
-            "holdout": int(round(vec[1])),
-            "loss": round(loss, 6),
-            "score": round(score, 6),
-            "bytesShipped": int(total_bytes),
-            "syncCount": int(sync_count),
-            "steps": self._steps_run,
-            # LOCAL count (process 0's workers): >0 proves the SSP requeue
-            # path executed in this run
-            "requeuedLocal": getattr(self.trainer, "requeued_rows", 0),
-        }
+        report = JobStatistics(
+            job_name=self.config.job_name,
+            parallelism=self.dp_global,
+            duration_ms=(time.time() - self.start_time) * 1000.0,
+            statistics=entries,
+        ).to_dict()
+        report["processes"] = self.nproc
+        report["holdout"] = holdout
+        # LOCAL count (process 0's workers): >0 proves the SSP requeue
+        # path executed in this run
+        report["requeuedLocal"] = requeued_local
+        return report
 
+    # --- checkpoint / restore (FlinkSpoke.scala:233-334 semantics) ---
 
-def run_distributed(argv: Optional[List[str]] = None) -> int:
-    import argparse
-    import os
+    def save_checkpoint(self, root: str, cursor: Any) -> str:
+        """Write a consistent distributed snapshot. Must be called at a
+        synchronized pump point by EVERY process with its own ``cursor``
+        (source position: row count for file striding, per-partition
+        offsets for Kafka). Layout::
 
-    # this environment's jax build pins its platform list at import and
-    # IGNORES the JAX_PLATFORMS env var; honor it explicitly before any
-    # backend/device initialization
-    if os.environ.get("JAX_PLATFORMS"):
+            root/ckpt-<k>/manifest.json     (proc 0: request lines, shape)
+            root/ckpt-<k>/fleet_<net>.npz   (proc 0: gathered fleet state)
+            root/ckpt-<k>/proc<p>.npz|.json (each: buffers + cursor)
+            root/LATEST                     (proc 0: pointer, flipped last)
+
+        The pointer flip happens only after a fabric barrier confirms every
+        process's files are durable — the atomic-commit role of a Flink
+        checkpoint barrier's acknowledgement."""
         import jax
 
-        try:
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+        k = self._ckpt_seq
+        self._ckpt_seq += 1
+        d = os.path.join(root, f"ckpt-{k}")
+        os.makedirs(d, exist_ok=True)
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--coordinator", default=None)
-    ap.add_argument("--processes", type=int, default=None)
-    ap.add_argument("--processId", type=int, default=None)
-    ap.add_argument("--requests", required=True)
-    ap.add_argument("--trainingData", required=True)
-    ap.add_argument("--performanceOut", default=None)
-    ap.add_argument("--predictionsOut", default=None)
-    ap.add_argument("--batchSize", type=int, default=256)
-    ap.add_argument("--testSetSize", type=int, default=64)
-    args = ap.parse_args(argv)
+        rep = NamedSharding(self.mesh, P())
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            if p._gather_state_jit is None:
+                specs = jax.tree_util.tree_map(lambda _: rep, p.trainer.state)
+                p._gather_state_jit = jax.jit(
+                    lambda s: s, out_shardings=specs
+                )
+            # the jitted gather is COLLECTIVE (every process dispatches
+            # it), but only process 0 pays the host fetch + write — the
+            # other processes' replicated copies never leave the device
+            gathered = p._gather_state_jit(p.trainer.state)
+            if self.pid == 0:
+                leaves = [
+                    self._fetch_replicated(l)
+                    for l in jax.tree_util.tree_leaves(gathered)
+                ]
+                _atomic_savez(
+                    os.path.join(d, f"fleet_{net_id}.npz"),
+                    {f"leaf_{i}": l for i, l in enumerate(leaves)},
+                )
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Any] = {
+            "cursor": cursor,
+            "pipelines": {},
+            # already-served outputs survive a restore: the request-topic
+            # offsets are checkpointed past an answered Query, so the
+            # response (and a deleted pipeline's predictions) would
+            # otherwise vanish from the final output files
+            "orphan_predictions": [
+                [int(n), float(v)] for n, v in self.orphan_predictions
+            ],
+        }
+        if self.pid == 0:
+            meta["responses"] = [r.to_dict() for r in self.responses]
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            pend_x = (
+                np.concatenate(p.pend_x)
+                if p.pend_x else np.zeros((0, p.dim), np.float32)
+            )
+            pend_y = (
+                np.concatenate(p.pend_y)
+                if p.pend_y else np.zeros((0,), np.float32)
+            )
+            fore_x = (
+                np.concatenate(p.fore_x)
+                if p.fore_x else np.zeros((0, p.dim), np.float32)
+            )
+            tx, ty = (
+                p.test_set.arrays() if len(p.test_set)
+                else (np.zeros((0, p.dim), np.float32), np.zeros((0,), np.float32))
+            )
+            arrays[f"n{net_id}_pend_x"] = pend_x
+            arrays[f"n{net_id}_pend_y"] = pend_y
+            arrays[f"n{net_id}_fore_x"] = fore_x
+            arrays[f"n{net_id}_test_x"] = np.asarray(tx, np.float32)
+            arrays[f"n{net_id}_test_y"] = np.asarray(ty, np.float32)
+            meta["pipelines"][str(net_id)] = {
+                "holdout_count": p.holdout_count,
+                "fitted": p.trainer.fitted,
+                "steps_host": p.trainer._steps_host,
+                "requeued": getattr(p.trainer, "requeued_rows", 0),
+                "steps_run": p.steps_run,
+                "predictions": p.predictions,
+                "curve": p.curve,
+                "global_rows": p.global_rows,
+            }
+        _atomic_savez(os.path.join(d, f"proc{self.pid}.npz"), arrays)
+        _atomic_write_json(os.path.join(d, f"proc{self.pid}.json"), meta)
+        if self.pid == 0:
+            _atomic_write_json(
+                os.path.join(d, "manifest.json"),
+                {
+                    "seq": k,
+                    "processes": self.nproc,
+                    "dp_global": self.dp_global,
+                    "request_lines": [
+                        self.pipelines[i].raw_line
+                        for i in sorted(self.pipelines)
+                    ],
+                },
+            )
+        self.barrier()  # every process's files durable before the flip
+        if self.pid == 0:
+            _atomic_write_bytes(
+                os.path.join(root, "LATEST"), f"ckpt-{k}".encode()
+            )
+        self.barrier()  # nobody races ahead of the visible pointer
+        return d
 
-    config = JobConfig(
-        batch_size=args.batchSize, test_set_size=args.testSetSize
-    )
-    job = DistributedStreamJob(
-        config,
-        coordinator=args.coordinator,
-        num_processes=args.processes,
-        process_id=args.processId,
-    )
-    # process 0 reads the request file; everyone else receives the
-    # broadcast (passing lines from a non-0 process is ignored)
-    lines: List[str] = []
-    if job.pid == 0:
-        with open(args.requests) as f:
-            lines = [l.strip() for l in f if l.strip()]
-    job.sync_requests(lines)
-    if job.trainer is None:
-        raise SystemExit(
-            "no pipeline deployed: the requests file must contain at least "
-            "one Create/Update with dataStructure.nFeatures "
-            f"({args.requests!r} yielded none)"
+    def restore_checkpoint(self, root: str) -> Optional[Any]:
+        """Resume every process from the latest consistent snapshot;
+        returns this process's saved cursor (None when no snapshot
+        exists). Must be called before any data is consumed, by every
+        process (the fleet-state placement is collective)."""
+        import jax
+
+        latest = os.path.join(root, "LATEST")
+        if not os.path.exists(latest):
+            return None
+        with open(latest, "rb") as f:
+            d = os.path.join(root, f.read().decode().strip())
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest["processes"] != self.nproc:
+            raise ValueError(
+                f"snapshot taken with {manifest['processes']} processes; "
+                f"restore requires the same count (got {self.nproc})"
+            )
+        self._ckpt_seq = int(manifest["seq"]) + 1
+        # redeploy the pipeline map from the recorded request lines (no
+        # broadcast needed: every process reads the same manifest). A live
+        # pipeline whose latest request was an Update redeploys as a Create
+        # — the gatekeeper would reject an Update for a pipeline that does
+        # not exist yet in this incarnation.
+        import dataclasses as _dc
+
+        for line in manifest["request_lines"]:
+            request = Request.from_json(line)
+            assert request is not None, "corrupt manifest request line"
+            if request.request == RequestType.UPDATE:
+                request = _dc.replace(request, request=RequestType.CREATE)
+            self._deploy(request, line)
+        from jax.sharding import PartitionSpec as P
+
+        from omldm_tpu.parallel.multihost import host_local_array
+
+        with open(os.path.join(d, f"proc{self.pid}.json")) as f:
+            meta = json.load(f)
+        self.orphan_predictions = [
+            (int(n), float(v))
+            for n, v in meta.get("orphan_predictions", [])
+        ]
+        if self.pid == 0:
+            self.responses.extend(
+                QueryResponse.from_dict(r) for r in meta.get("responses", [])
+            )
+        arrays = np.load(os.path.join(d, f"proc{self.pid}.npz"))
+        lo = self.pid * self.dp_local
+        for net_id in sorted(self.pipelines):
+            p = self.pipelines[net_id]
+            fleet = np.load(os.path.join(d, f"fleet_{net_id}.npz"))
+            flat_state, treedef = jax.tree_util.tree_flatten(p.trainer.state)
+            placed = []
+            for i in range(len(flat_state)):
+                full = fleet[f"leaf_{i}"]
+                local = full[lo : lo + self.dp_local]
+                placed.append(
+                    host_local_array(local, self.mesh, P("dp", "hub"))
+                )
+            p.trainer.state = jax.tree_util.tree_unflatten(treedef, placed)
+            pm = meta["pipelines"][str(net_id)]
+            p.holdout_count = int(pm["holdout_count"])
+            p.trainer._fitted_host = int(pm["fitted"])
+            p.trainer._steps_host = int(pm["steps_host"])
+            p.trainer.requeued_rows = int(pm["requeued"])
+            p.steps_run = int(pm["steps_run"])
+            p.predictions = list(pm["predictions"])
+            p.curve = [(float(l), int(r)) for l, r in pm["curve"]]
+            p.global_rows = int(pm["global_rows"])
+            px = arrays[f"n{net_id}_pend_x"]
+            if px.shape[0]:
+                p.pend_x = [px]
+                p.pend_y = [arrays[f"n{net_id}_pend_y"]]
+                p.pend_n = int(px.shape[0])
+            fx = arrays[f"n{net_id}_fore_x"]
+            if fx.shape[0]:
+                p.fore_x = [fx]
+                p.fore_n = int(fx.shape[0])
+            tx = arrays[f"n{net_id}_test_x"]
+            if tx.shape[0]:
+                p.test_set.append_many(tx, arrays[f"n{net_id}_test_y"])
+        return meta["cursor"]
+
+
+# --- drive loops -----------------------------------------------------------
+
+
+def _flag_true(flags: Dict[str, str], key: str) -> bool:
+    return flags.get(key, "").lower() in ("true", "1", "yes")
+
+
+def _maybe_checkpoint_and_fail(
+    job: DistributedStreamJob, flags: Dict[str, str],
+    chunk_idx: int, cursor: Any,
+) -> None:
+    """Synchronized checkpoint cadence + deterministic fault injection.
+    Every process evaluates the same condition at the same chunk index, so
+    checkpoints are collective-consistent and an injected crash kills the
+    whole deployment at one cut (the supervisor then relaunches every
+    process with --restore, Flink's global-restart strategy)."""
+    every = int(flags.get("checkpointEvery", "0"))
+    root = flags.get("checkpointDir")
+    if every > 0 and root and (chunk_idx + 1) % every == 0:
+        job.save_checkpoint(root, cursor)
+    fail_after = int(flags.get("failAfterChunks", "0"))
+    if fail_after and chunk_idx + 1 >= fail_after:
+        print(
+            f"[distributed p{job.pid}] injected failure after chunk "
+            f"{chunk_idx + 1}",
+            file=sys.stderr,
+            flush=True,
         )
+        os._exit(3)
 
-    # strided partition of the stream: row i belongs to process i % nproc
+
+def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
+    """Strided partition of a shared JSON-lines file: row i belongs to
+    process i % nproc (the deterministic stand-in for a Kafka partition
+    assignment; the whole-file read models the shared offsets). Uses the
+    same fused C ingest parser as the single-process CLI."""
     from omldm_tpu.runtime.fast_ingest import iter_file_batches
 
+    resume_cursor = 0
+    if _flag_true(flags, "restore") and flags.get("checkpointDir"):
+        cur = job.restore_checkpoint(flags["checkpointDir"])
+        if cur is not None:
+            resume_cursor = int(cur)
+            job._warn(f"restored; resuming at row {resume_cursor}")
+    assert job.dim is not None, "no pipeline deployed and no snapshot found"
     cursor = 0
+    chunk_idx = 0
+    chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
     for bx, by, bop in iter_file_batches(
-        args.trainingData, job.dim, 4096
+        flags["trainingData"], job.dim, chunk_rows
     ):
         n = bx.shape[0]
+        if cursor + n <= resume_cursor:
+            cursor += n
+            continue
+        if cursor < resume_cursor:
+            skip = resume_cursor - cursor
+            bx, by, bop = bx[skip:], by[skip:], bop[skip:]
+            cursor = resume_cursor
+            n = bx.shape[0]
         gidx = cursor + np.arange(n)
         mine = (gidx % job.nproc) == job.pid
         cursor += n
@@ -616,23 +1126,361 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         if fore.any():
             job.handle_forecast_rows(bx[fore])
         # synchronized pump point: every process sees the same chunk
-        # sequence (the whole-file read models the shared Kafka offsets)
+        # sequence
         job.pump()
+        _maybe_checkpoint_and_fail(job, flags, chunk_idx, cursor)
+        chunk_idx += 1
     job.flush()
-    if args.predictionsOut and job.predictions:
-        with open(args.predictionsOut, "w") as f:
-            for v in job.predictions:
-                f.write(json.dumps({"mlpId": 0, "value": v}) + "\n")
+
+
+def _tp_key(tp) -> str:
+    return f"{tp.topic}:{tp.partition}"
+
+
+def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
+    """Partitioned Kafka ingest: each process consumes an ASSIGNED set of
+    partitions (partition index mod nproc — Flink's static per-subtask
+    assignment, KafkaUtils.scala:11-31 / README.md:22-26, rather than
+    broker-side group rebalance), tracks per-partition offsets for
+    checkpointing, and pumps at synchronized poll windows. Mid-stream
+    requests are polled from the requests topic by process 0 and broadcast
+    over the fabric each window. Record values are parsed by the fused C
+    ingest parser (PackedBatcher), one batcher per topic so forecast-topic
+    records are forced to the forecast operation like the single-process
+    sources."""
+    try:
+        from kafka import KafkaConsumer, TopicPartition
+    except ImportError as e:
+        raise ImportError(
+            "Kafka ingest needs the 'kafka-python' package (or an injected "
+            "compatible module); use --trainingData file replay otherwise."
+        ) from e
+    from omldm_tpu.runtime.fast_ingest import PackedBatcher
+
+    brokers = flags["kafkaBrokers"]
+    train_topic = flags.get("kafkaTrainTopic", "trainingData")
+    fore_topic = flags.get("kafkaForecastTopic", "forecastingData")
+    req_topic = flags.get("kafkaRequestTopic", "requests")
+    poll_ms = int(flags.get("kafkaPollMs", "300"))
+
+    offsets: Dict[str, int] = {}
+    req_offsets: Dict[str, int] = {}
+    if _flag_true(flags, "restore") and flags.get("checkpointDir"):
+        cur = job.restore_checkpoint(flags["checkpointDir"])
+        if cur is not None:
+            offsets = dict(cur.get("data", {}))
+            req_offsets = dict(cur.get("requests", {}))
+            job._warn(f"restored; resuming at offsets {offsets}")
+
+    consumer = KafkaConsumer(
+        bootstrap_servers=brokers, consumer_timeout_ms=poll_ms
+    )
+
+    def _partitions(client, topic, retries=5):
+        for attempt in range(retries):
+            if attempt:
+                time.sleep(0.2 * attempt)
+            parts = client.partitions_for_topic(topic)
+            if parts:
+                return sorted(parts)
+        return []
+
+    def _seek_or_resume(client, tp, saved_offsets):
+        """Seek to the snapshot offset, else to the LOG START — recording
+        the broker-reported position (not a literal 0: a retention-trimmed
+        partition starts later, and checkpointing 0 would make restore
+        seek out of range and silently fall back to 'latest')."""
+        saved = saved_offsets.get(_tp_key(tp))
+        if saved is not None:
+            client.seek(tp, saved)
+            return
+        # bounded experiment streams consume from the start (the
+        # reference's runs pre-load partitioned topics, README.md:22-26)
+        client.seek_to_beginning(tp)
+        try:
+            saved_offsets[_tp_key(tp)] = int(client.position(tp))
+        except Exception:
+            saved_offsets[_tp_key(tp)] = 0
+
+    # partition -> process assignment over the union of the data topics'
+    # partitions (consumer-group semantics without a broker coordinator).
+    # Process 0's metadata view is AUTHORITATIVE and travels over the
+    # fabric: independently-retried partitions_for_topic views can diverge
+    # on freshly-created topics, which would silently double-assign or
+    # drop partitions if each process striped its own list. While the list
+    # is still empty (topics not yet auto-created — the supported
+    # late-start pattern the startup idle bound waits through), the drive
+    # loop re-runs this until partitions appear.
+    assigned: List[Any] = []
+    discovered = [False]  # the GLOBAL list was non-empty (broadcast-agreed)
+
+    def _assign_partitions(retries: int) -> None:
+        assign_payload: List[str] = []
+        if job.pid == 0:
+            all_tps0 = []
+            for topic in (train_topic, fore_topic):
+                for pnum in _partitions(consumer, topic, retries):
+                    all_tps0.append([topic, pnum])
+            assign_payload = [json.dumps({"assign": all_tps0})]
+        [assign_line] = job._broadcast_lines(assign_payload)
+        all_tps = [
+            TopicPartition(t, p)
+            for t, p in json.loads(assign_line)["assign"]
+        ]
+        if not all_tps:
+            return
+        discovered[0] = True
+        assigned.extend(
+            tp for i, tp in enumerate(all_tps) if i % job.nproc == job.pid
+        )
+        if assigned:
+            consumer.assign(assigned)
+            for tp in assigned:
+                _seek_or_resume(consumer, tp, offsets)
+
+    _assign_partitions(retries=5)
+    # process 0 owns the request topic (single-partition control stream);
+    # its offsets are checkpointed too — replaying the whole topic on a
+    # restore would re-run Updates (wiping the restored model) and
+    # re-answer Queries
+    req_consumer = None
+    if job.pid == 0:
+        req_consumer = KafkaConsumer(
+            bootstrap_servers=brokers, consumer_timeout_ms=poll_ms
+        )
+        req_tps = [
+            TopicPartition(req_topic, p)
+            for p in _partitions(req_consumer, req_topic)
+        ]
+        if req_tps:
+            req_consumer.assign(req_tps)
+            for tp in req_tps:
+                _seek_or_resume(req_consumer, tp, req_offsets)
+
+    chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
+    # batchers are built once the stream width is known (the first Create
+    # may arrive on the requests topic mid-run); until then data partitions
+    # are simply not polled, so their offsets — and the records — wait in
+    # the broker exactly as they would for a slow Flink subtask
+    batchers: Dict[str, Any] = {}
+
+    def _ensure_batchers():
+        if not batchers and job.dim is not None:
+            batchers[train_topic] = PackedBatcher(job.dim, chunk_rows)
+            batchers[fore_topic] = PackedBatcher(job.dim, chunk_rows)
+        return bool(batchers)
+
+    def _feed(topic, batches):
+        for bx, by, bop in batches:
+            if topic == fore_topic:
+                job.handle_forecast_rows(bx)
+            else:
+                train = bop == 0
+                if train.any():
+                    job.handle_partition_rows(bx[train], by[train])
+                if (~train).any():
+                    job.handle_forecast_rows(bx[~train])
+
+    chunk_idx = 0
+    idle_windows = 0
+    idle_limit = int(flags.get("idleWindows", "2"))
+    startup_limit = int(flags.get("startupIdleWindows", "600"))
+    # restores count as deployed: the manifest already rebuilt pipelines
+    ever_deployed = bool(job.pipelines)
+    while True:
+        # 1. control plane: new request lines, broadcast to everyone
+        req_lines: List[str] = []
+        if req_consumer is not None:
+            while True:
+                try:
+                    rec = next(req_consumer)
+                except StopIteration:
+                    break
+                req_offsets[_tp_key(rec)] = rec.offset + 1
+                v = rec.value
+                req_lines.append(
+                    v.decode("utf-8", "replace") if isinstance(v, bytes) else v
+                )
+        job.sync_requests(req_lines)
+        # 1b. late partition discovery: data topics auto-created after
+        # launch get assigned once their metadata appears (single attempt
+        # per window; the decision to re-try is broadcast-agreed, so every
+        # process keeps issuing the same collectives)
+        if not discovered[0]:
+            _assign_partitions(retries=1)
+        # 2. data: drain this window's records from the assigned partitions
+        had_rows = 0
+        polled = 0
+        while _ensure_batchers() and polled < chunk_rows:
+            try:
+                rec = next(consumer)
+            except StopIteration:
+                break
+            polled += 1
+            had_rows = 1
+            offsets[_tp_key(rec)] = rec.offset + 1
+            v = rec.value
+            line = v if isinstance(v, bytes) else str(v).encode()
+            b = batchers.get(rec.topic)
+            if b is None:
+                continue
+            buf = bytearray(line)
+            if not buf.endswith(b"\n"):
+                buf += b"\n"
+            _feed(rec.topic, b.feed_buffer(buf, 0, len(buf)))
+        for topic, b in batchers.items():
+            tail = b.flush()
+            if tail:
+                _feed(topic, [tail])
+        # 3. synchronized pump + checkpoint cadence
+        job.pump()
+        _maybe_checkpoint_and_fail(
+            job, flags, chunk_idx,
+            {"data": offsets, "requests": req_offsets},
+        )
+        chunk_idx += 1
+        # 4. agreed termination: stop after idleWindows globally-idle poll
+        # windows (the silence-timer termination of
+        # StatisticsOperator.scala:135-142, with the timeout measured in
+        # fabric-agreed windows). Before ANY pipeline exists the much
+        # larger startup bound applies — a live job must not die in the
+        # first second waiting for its Create to reach the requests topic.
+        # (job.pipelines is identical on every process: the control plane
+        # is broadcast, so this branch needs no extra collective.)
+        globally_quiet = job._collective_reduce(
+            [float(had_rows + len(req_lines))], "sum"
+        )[0] == 0
+        ever_deployed = ever_deployed or bool(job.pipelines)
+        if globally_quiet:
+            idle_windows += 1
+            # once ANY pipeline has existed the short bound applies —
+            # a Delete of the last pipeline means the job's work is done,
+            # not that it should re-enter the startup grace period
+            limit = idle_limit if ever_deployed else startup_limit
+            if idle_windows >= limit:
+                if not ever_deployed:
+                    job._warn(
+                        "no Create arrived within the startup idle bound; "
+                        "terminating with nothing deployed"
+                    )
+                break
+        else:
+            idle_windows = 0
+    job.flush()
+    consumer.close()
+    if req_consumer is not None:
+        req_consumer.close()
+
+
+def run_distributed(argv: Optional[List[str]] = None) -> int:
+    # this environment's jax build pins its platform list at import and
+    # IGNORES the JAX_PLATFORMS env var; honor it explicitly before any
+    # backend/device initialization
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except (ValueError, AttributeError) as exc:
+            # a failed override must be LOUD: silently initializing on the
+            # wrong backend (e.g. grabbing the TPU in a CPU smoke test)
+            # makes every later failure mysterious
+            print(
+                f"warning: could not apply JAX_PLATFORMS="
+                f"{os.environ['JAX_PLATFORMS']!r}: {exc}",
+                file=sys.stderr,
+            )
+
+    from omldm_tpu.__main__ import parse_flags
+
+    flags = parse_flags(list(argv or []))
+    if not flags.get("kafkaBrokers"):
+        if "trainingData" not in flags:
+            raise SystemExit("--trainingData is required in file mode")
+        if "requests" not in flags and not _flag_true(flags, "restore"):
+            raise SystemExit(
+                "--requests is required (or --restore with a checkpoint)"
+            )
+
+    config = JobConfig(
+        job_name=flags.get("jobName", "OMLDM"),
+        batch_size=int(flags.get("batchSize", "256")),
+        test_set_size=int(flags.get("testSetSize", "64")),
+    )
+    nproc_flag = int(flags.get("processes", "0"))
+    # --processes 1 with no coordinator is a plain single-process run;
+    # jax.distributed requires a coordinator address otherwise
+    use_group = flags.get("coordinator") is not None and nproc_flag > 1
+    job = DistributedStreamJob(
+        config,
+        coordinator=flags.get("coordinator") if use_group else None,
+        num_processes=nproc_flag if use_group else None,
+        process_id=int(flags["processId"]) if use_group else None,
+    )
+    # process 0 reads the request file; everyone else receives the
+    # broadcast (passing lines from a non-0 process is ignored). On a
+    # restore the manifest redeploys the pipeline map instead — the
+    # requests file was fully consumed before the first snapshot.
+    restoring = _flag_true(flags, "restore") and bool(
+        flags.get("checkpointDir")
+    ) and os.path.exists(os.path.join(flags["checkpointDir"], "LATEST"))
+    if not restoring:
+        lines: List[str] = []
+        if job.pid == 0 and flags.get("requests"):
+            with open(flags["requests"]) as f:
+                lines = [l.strip() for l in f if l.strip()]
+        job.sync_requests(lines)
+    if flags.get("kafkaBrokers"):
+        # a job may start with no pipelines: the Create can arrive on the
+        # requests topic mid-run (startupIdleWindows bounds the wait)
+        _drive_kafka(job, flags)
+    else:
+        if not restoring and not job.pipelines:
+            raise SystemExit(
+                "no pipeline deployed: the requests file must contain at "
+                "least one valid Create/Update with "
+                f"dataStructure.nFeatures ({flags.get('requests')!r})"
+            )
+        _drive_file(job, flags)
+
+    # post-training control-plane sync point: a second request file handled
+    # after the stream drains (deterministic query-after-training — the
+    # pattern the reference exercises by publishing a Query to the requests
+    # topic once training data stops flowing, PipelineMap.scala:37-42).
+    # Queries here see the fully-trained model; Deletes drop pipelines from
+    # the final report.
+    if flags.get("requestsFinal"):
+        final_lines: List[str] = []
+        if job.pid == 0:
+            with open(flags["requestsFinal"]) as f:
+                final_lines = [l.strip() for l in f if l.strip()]
+        job.sync_requests(final_lines)
+
+    # outputs: predictions per process (suffixed — a shared path would be
+    # clobbered by the last writer and lose the other partitions' rows),
+    # responses + performance from process 0
+    if flags.get("predictionsOut"):
+        path = flags["predictionsOut"]
+        if job.nproc > 1:
+            path = f"{path}.p{job.pid}"
+        with open(path, "w") as f:
+            for net_id, v in job.orphan_predictions:
+                f.write(json.dumps({"mlpId": net_id, "value": v}) + "\n")
+            for net_id in sorted(job.pipelines):
+                for v in job.pipelines[net_id].predictions:
+                    f.write(json.dumps({"mlpId": net_id, "value": v}) + "\n")
     report = job.merged_report()
-    if report is not None and args.performanceOut:
-        with open(args.performanceOut, "w") as f:
-            f.write(json.dumps(report) + "\n")
     if report is not None:
+        if flags.get("responsesOut"):
+            with open(flags["responsesOut"], "w") as f:
+                for resp in job.responses:
+                    f.write(resp.to_json() + "\n")
+        if flags.get("performanceOut"):
+            with open(flags["performanceOut"], "w") as f:
+                f.write(json.dumps(report) + "\n")
         print(json.dumps(report))
     return 0
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(run_distributed(sys.argv[1:]))

@@ -243,7 +243,20 @@ def connect_kafka(
                 # data partition the snapshot never recorded: the original
                 # consumer (subscribe mode, latest) started at the live
                 # end — replaying retained history it never consumed would
-                # train on and emit predictions for arbitrarily old data
+                # train on and emit predictions for arbitrarily old data.
+                # Seeding at connect is best-effort, so a partition created
+                # (or left unseeded) between connect and the crash loses
+                # whatever it received before this recovery: WARN so the
+                # operator can see the potential gap instead of silence
+                import sys as _sys
+
+                print(
+                    f"warning: data partition {tp.topic}:{tp.partition} "
+                    "has no snapshot offset; seeking to live END — any "
+                    "records delivered to it before this recovery are "
+                    "skipped (tracker seeding may have failed at connect)",
+                    file=_sys.stderr,
+                )
                 consumer.seek_to_end(tp)
             # record where this incarnation starts each partition so the
             # NEXT snapshot covers it — without this, a partition that
@@ -269,6 +282,14 @@ def connect_kafka(
             # Single metadata attempt per topic: seeding is best-effort and
             # a not-yet-created topic (broker auto-creation) must not stall
             # startup behind the retry backoff.
+            # KNOWN WINDOW: a latest-mode subscriber's true start position
+            # is assigned at the first rebalance, slightly AFTER this
+            # end_offsets call. Records arriving in between are consumed
+            # and overwrite the seed; but a crash before the first record
+            # of a partition replays from the (older) seeded offset — a
+            # small duplicate-training window, the benign direction for a
+            # streaming learner (at-least-once, like the reference's
+            # restart without committed offsets).
             for topic in topic_map:
                 parts = consumer.partitions_for_topic(topic)
                 if not parts:

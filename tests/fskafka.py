@@ -1,0 +1,159 @@
+"""File-backed fake of kafka-python's client surface, shared ACROSS processes.
+
+The in-process loopback fake (tests/test_kafka_client.py) exercises
+``connect_kafka`` inside one interpreter; the multi-process deployment needs
+a broker every REAL process can reach. This module models one as a
+directory: each (topic, partition) is a line-oriented log file
+``<topic>--<partition>.log`` and offsets are line numbers — enough of
+kafka-python's consumer/producer surface (assign/seek/seek_to_beginning/
+seek_to_end/partitions_for_topic/end_offsets/position/iteration-with-idle,
+KafkaProducer.send, TopicPartition) for the distributed job's partitioned
+ingest to run unmodified. ``install()`` registers it as the ``kafka``
+module; subprocesses do the same via ``python -c`` bootstrap.
+
+Reference counterpart of what this enables: the partitioned Kafka topics
+feeding N parallel subtasks (README.md:21-26, KafkaUtils.scala:11-31).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import namedtuple
+from typing import Dict, List, Optional
+
+TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+ConsumerRecord = namedtuple(
+    "ConsumerRecord", ["topic", "partition", "offset", "value"]
+)
+
+_ENV = "FSKAFKA_DIR"
+
+
+def _root() -> str:
+    d = os.environ.get(_ENV)
+    if not d:
+        raise RuntimeError(f"{_ENV} is not set; fskafka has no broker dir")
+    return d
+
+
+def _log_path(topic: str, partition: int) -> str:
+    return os.path.join(_root(), f"{topic}--{partition}.log")
+
+
+def append(topic: str, value, partition: int = 0) -> None:
+    """Test helper: publish one record (a line) to a partition log."""
+    data = value if isinstance(value, bytes) else str(value).encode()
+    os.makedirs(_root(), exist_ok=True)
+    with open(_log_path(topic, partition), "ab") as f:
+        f.write(data.rstrip(b"\n") + b"\n")
+
+
+class _Log:
+    """Cached view of one partition log; refreshed when the file grows."""
+
+    def __init__(self, topic: str, partition: int):
+        self.path = _log_path(topic, partition)
+        self._size = -1
+        self._lines: List[bytes] = []
+
+    def lines(self) -> List[bytes]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size != self._size:
+            with open(self.path, "rb") as f:
+                self._lines = f.read().splitlines()
+            self._size = size
+        return self._lines
+
+
+class KafkaConsumer:
+    def __init__(self, *topics, bootstrap_servers=None,
+                 consumer_timeout_ms: int = 1000, **_):
+        self._logs: Dict[TopicPartition, _Log] = {}
+        self._positions: Dict[TopicPartition, int] = {}
+        self._rr = 0
+        if topics:
+            # subscribe mode starts at the live end (kafka-python latest)
+            for t in topics:
+                for p in self.partitions_for_topic(t) or set():
+                    tp = TopicPartition(t, p)
+                    self._positions[tp] = self._log(tp).lines().__len__()
+        self.closed = False
+
+    def _log(self, tp: TopicPartition) -> _Log:
+        log = self._logs.get(tp)
+        if log is None:
+            log = self._logs[tp] = _Log(tp.topic, tp.partition)
+        return log
+
+    # --- metadata / assignment surface ---
+
+    def partitions_for_topic(self, topic: str) -> Optional[set]:
+        try:
+            names = os.listdir(_root())
+        except OSError:
+            return None
+        parts = {
+            int(n[len(topic) + 2 : -4])
+            for n in names
+            if n.startswith(f"{topic}--") and n.endswith(".log")
+        }
+        return parts or None
+
+    def end_offsets(self, tps):
+        return {tp: len(self._log(tp).lines()) for tp in tps}
+
+    def assign(self, tps) -> None:
+        self._positions = {tp: 0 for tp in tps}
+
+    def seek(self, tp, offset: int) -> None:
+        self._positions[tp] = int(offset)
+
+    def seek_to_beginning(self, tp) -> None:
+        self._positions[tp] = 0
+
+    def seek_to_end(self, tp) -> None:
+        self._positions[tp] = len(self._log(tp).lines())
+
+    def position(self, tp) -> int:
+        return self._positions.get(tp, 0)
+
+    # --- record iteration (StopIteration = idle poll window) ---
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ConsumerRecord:
+        tps = sorted(self._positions)
+        n = len(tps)
+        for i in range(n):
+            tp = tps[(self._rr + i) % n]
+            lines = self._log(tp).lines()
+            off = self._positions[tp]
+            if off < len(lines):
+                self._positions[tp] = off + 1
+                self._rr = (self._rr + i + 1) % max(n, 1)
+                return ConsumerRecord(tp.topic, tp.partition, off, lines[off])
+        raise StopIteration  # idle window; next() resumes fetching
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class KafkaProducer:
+    def __init__(self, bootstrap_servers=None, **_):
+        self.closed = False
+
+    def send(self, topic: str, value) -> None:
+        append(topic, value, 0)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def install() -> None:
+    """Register this module as ``kafka`` so production imports resolve."""
+    sys.modules["kafka"] = sys.modules[__name__]

@@ -2,9 +2,13 @@
 
 Spawns REAL separate Python processes joined via jax.distributed (CPU
 backend + Gloo collectives): process 0 owns the control plane and
-broadcasts the Create over the fabric; each process trains its strided
-partition of the stream; statistics merge collectively. Score must agree
-with the same job run single-process.
+broadcasts requests over the fabric; each process trains its partition of
+the stream; statistics merge collectively into the JobStatistics schema.
+Covers the full control-plane vocabulary in the cluster shape (multiple
+pipelines, Query answered collectively, Delete honored, invalid requests
+logged — PipelineMap.scala:37-57 semantics), distributed checkpoint/resume
+(FlinkSpoke.scala:233-334), and partitioned Kafka ingest against a
+file-backed broker fake (KafkaUtils.scala:11-31, README.md:21-26).
 """
 
 import json
@@ -17,6 +21,16 @@ import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+
+# bootstrap that installs the file-backed kafka fake before production code
+# imports `kafka` (real subprocesses cannot share an in-process fake)
+FSKAFKA_BOOT = (
+    "import sys; sys.path.insert(0, {tests!r}); "
+    "import fskafka; fskafka.install(); "
+    "from omldm_tpu.runtime.distributed_job import run_distributed; "
+    "sys.exit(run_distributed(sys.argv[1:]))"
+).format(tests=TESTS)
 
 
 def _free_port():
@@ -27,77 +41,80 @@ def _free_port():
     return port
 
 
-def _write_stream(path, n=3000, dim=12, seed=0, forecast_every=0):
+def _rows(n, dim, seed=0, forecast_every=0):
+    """(record JSON lines, number of forecast rows)."""
     rng = np.random.RandomState(seed)
     w = rng.randn(dim)
-    n_fore = 0
+    lines, n_fore = [], 0
+    for i in range(n):
+        x = np.round(rng.randn(dim), 6)
+        if forecast_every and i % forecast_every == 0:
+            n_fore += 1
+            lines.append(json.dumps({
+                "numericalFeatures": [float(v) for v in x],
+                "operation": "forecasting",
+            }))
+        else:
+            lines.append(json.dumps({
+                "numericalFeatures": [float(v) for v in x],
+                "target": float(x @ w > 0),
+                "operation": "training",
+            }))
+    return lines, n_fore
+
+
+def _write_stream(path, n=3000, dim=12, seed=0, forecast_every=0):
+    lines, n_fore = _rows(n, dim, seed, forecast_every)
     with open(path, "w") as f:
-        for i in range(n):
-            x = np.round(rng.randn(dim), 6)
-            # forecast slots at index 0 of each cycle: EVEN stream
-            # indices whenever forecast_every is even (partition-targeted
-            # imbalance for the SSP test)
-            if forecast_every and i % forecast_every == 0:
-                n_fore += 1
-                f.write(
-                    json.dumps(
-                        {
-                            "numericalFeatures": [float(v) for v in x],
-                            "operation": "forecasting",
-                        }
-                    )
-                    + "\n"
-                )
-                continue
-            f.write(
-                json.dumps(
-                    {
-                        "numericalFeatures": [float(v) for v in x],
-                        "target": float(x @ w > 0),
-                        "operation": "training",
-                    }
-                )
-                + "\n"
-            )
+        f.write("\n".join(lines) + "\n")
     return n_fore
 
 
-CREATE = {
-    "id": 0,
-    "request": "Create",
-    "learner": {
-        "name": "PA",
-        "hyperParameters": {"C": 1.0},
-        "dataStructure": {"nFeatures": 12},
-    },
-    "preProcessors": [],
-    "trainingConfiguration": {"protocol": "Synchronous", "syncEvery": 1},
-}
+def _create(net_id=0, protocol="Synchronous", dim=12, **tc_extra):
+    tc = {"protocol": protocol, "syncEvery": 1}
+    tc.update(tc_extra)
+    return json.dumps({
+        "id": net_id,
+        "request": "Create",
+        "learner": {
+            "name": "PA",
+            "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": dim},
+        },
+        "preProcessors": [],
+        "trainingConfiguration": tc,
+    })
 
 
-def _run_procs(tmp_path, nproc, train, reqs, timeout=300):
-    """Launch nproc real processes; returns (merged report, predictions)."""
+def _stat(report, net_id):
+    [s] = [s for s in report["statistics"] if s["pipeline"] == net_id]
+    return s
+
+
+def _launch(tmp_path, nproc, extra_flags, tag, boot=None, env_extra=None,
+            expect_rc=0, timeout=420):
+    """Run nproc processes of the distributed job; returns
+    (report or None, predictions, joined stderr)."""
     port = _free_port()
+    perf = tmp_path / f"perf_{tag}.jsonl"
+    preds = tmp_path / f"preds_{tag}.jsonl"
     procs = []
-    outs = []
-    pred_files = []
     for pid in range(nproc):
-        perf = tmp_path / f"perf_{nproc}_{pid}.jsonl"
-        preds = tmp_path / f"preds_{nproc}_{pid}.jsonl"
-        outs.append(perf)
-        pred_files.append(preds)
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # one CPU device per process
         env["JAX_PLATFORMS"] = "cpu"
-        args = [
-            sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
-            "--requests", str(reqs),
-            "--trainingData", str(train),
+        env.update(env_extra or {})
+        head = (
+            [sys.executable, "-c", boot]
+            if boot
+            else [sys.executable, "-m", "omldm_tpu.runtime.distributed_job"]
+        )
+        args = head + [
             "--performanceOut", str(perf),
             "--predictionsOut", str(preds),
             "--batchSize", "64",
             "--testSetSize", "32",
-        ]
+        ] + extra_flags
         if nproc > 1:
             args += [
                 "--coordinator", f"127.0.0.1:{port}",
@@ -110,18 +127,28 @@ def _run_procs(tmp_path, nproc, train, reqs, timeout=300):
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             )
         )
+    errs = []
     for p in procs:
         out, err = p.communicate(timeout=timeout)
-        assert p.returncode == 0, f"proc failed:\n{out}\n{err[-3000:]}"
-    report_path = outs[0]
-    [line] = report_path.read_text().strip().splitlines()
-    preds = []
-    for pf in pred_files:
-        if pf.exists():
-            preds.extend(
+        errs.append(err)
+        assert p.returncode == expect_rc, (
+            f"proc exited {p.returncode} (wanted {expect_rc}):\n{out}\n{err[-3000:]}"
+        )
+    report = None
+    if perf.exists():
+        [line] = perf.read_text().strip().splitlines()
+        report = json.loads(line)
+    predictions = []
+    pred_paths = (
+        [preds] if nproc == 1
+        else [tmp_path / f"preds_{tag}.jsonl.p{i}" for i in range(nproc)]
+    )
+    for pf in pred_paths:
+        if pf.exists() and pf.read_text().strip():
+            predictions.extend(
                 json.loads(l) for l in pf.read_text().strip().splitlines()
             )
-    return json.loads(line), preds
+    return report, predictions, "\n".join(errs)
 
 
 @pytest.mark.slow
@@ -130,25 +157,36 @@ class TestDistributedStreamJob:
         train = tmp_path / "train.jsonl"
         reqs = tmp_path / "reqs.jsonl"
         _write_stream(str(train))
-        reqs.write_text(json.dumps(CREATE) + "\n")
+        reqs.write_text(_create() + "\n")
+        flags = ["--requests", str(reqs), "--trainingData", str(train)]
 
-        single, _ = _run_procs(tmp_path, 1, train, reqs)
-        double, _ = _run_procs(tmp_path, 2, train, reqs)
+        single, _, _ = _launch(tmp_path, 1, flags, "single")
+        double, _, _ = _launch(tmp_path, 2, flags, "double")
 
+        # the report is the reference's JobStatistics schema
+        for rep in (single, double):
+            assert set(rep) >= {
+                "jobName", "parallelism", "durationMs", "statistics",
+                "processes", "holdout",
+            }
+        s1, s2 = _stat(single, 0), _stat(double, 0)
         # every row lands somewhere: fitted + holdout-resident == total
-        assert single["fitted"] + single["holdout"] == 3000
-        assert double["fitted"] + double["holdout"] == 3000
+        assert s1["fitted"] + single["holdout"]["0"] == 3000
+        assert s2["fitted"] + double["holdout"]["0"] == 3000
         assert double["processes"] == 2
         assert double["parallelism"] == 2  # one device per process
         # the learned model separates the stream on BOTH deployments, and
         # the scores agree (staging order differs slightly between the
         # partitionings, so parity is close, not bit-equal)
-        assert single["score"] > 0.85
-        assert double["score"] > 0.85
-        assert abs(single["score"] - double["score"]) < 0.05
-        # protocol traffic happened on the distributed run
-        assert double["syncCount"] > 0
-        assert double["bytesShipped"] > 0
+        assert s1["score"] > 0.85
+        assert s2["score"] > 0.85
+        assert abs(s1["score"] - s2["score"]) < 0.05
+        # protocol traffic happened and the learning curve was recorded
+        assert s2["numOfBlocks"] > 0
+        assert s2["bytesShipped"] > 0
+        assert len(s2["learningCurve"]) > 0
+        assert s2["LCX"] == sorted(s2["LCX"])
+        assert s2["LCX"][-1] <= s2["fitted"]
 
     def test_ssp_two_processes_conserves_rows(self, tmp_path):
         """SSP across processes with DELIBERATELY imbalanced partitions
@@ -162,25 +200,219 @@ class TestDistributedStreamJob:
         # partition (strided i % 2); its training rows lag process 1's
         n_fore = _write_stream(str(train), n=2400, forecast_every=4)
         assert n_fore > 0
-        create = json.loads(json.dumps(CREATE))
-        create["trainingConfiguration"] = {
-            "protocol": "SSP", "syncEvery": 1, "staleness": 1,
-        }
-        reqs.write_text(json.dumps(create) + "\n")
-        report, preds = _run_procs(tmp_path, 2, train, reqs)
-        assert report["fitted"] + report["holdout"] == 2400 - n_fore
+        reqs.write_text(_create(protocol="SSP", staleness=1) + "\n")
+        report, preds, _ = _launch(
+            tmp_path, 2,
+            ["--requests", str(reqs), "--trainingData", str(train)],
+            "ssp",
+        )
+        s = _stat(report, 0)
+        assert s["fitted"] + report["holdout"]["0"] == 2400 - n_fore
         assert len(preds) == n_fore
-        assert report["syncCount"] > 0
+        assert s["numOfBlocks"] > 0
 
     def test_forecasts_served_across_processes(self, tmp_path):
         """Forecast rows in any partition produce predictions (served
-        collectively — the model is sharded across processes)."""
+        collectively — the model is sharded across processes), written to
+        per-process output files (a shared path would be clobbered)."""
         train = tmp_path / "train.jsonl"
         reqs = tmp_path / "reqs.jsonl"
         n_fore = _write_stream(str(train), n=1500, forecast_every=100)
         assert n_fore > 0
-        reqs.write_text(json.dumps(CREATE) + "\n")
-        report, preds = _run_procs(tmp_path, 2, train, reqs)
+        reqs.write_text(_create() + "\n")
+        report, preds, _ = _launch(
+            tmp_path, 2,
+            ["--requests", str(reqs), "--trainingData", str(train)],
+            "fore",
+        )
         assert len(preds) == n_fore
         assert all(np.isfinite(p["value"]) for p in preds)
-        assert report["fitted"] + report["holdout"] == 1500 - n_fore
+        s = _stat(report, 0)
+        assert s["fitted"] + report["holdout"]["0"] == 1500 - n_fore
+
+    def test_multi_pipeline_query_delete(self, tmp_path):
+        """The cluster deployment hosts the FULL control plane: two
+        concurrent pipelines (SpokeLogic.scala:28-29), invalid requests
+        logged and dropped (PipelineMap.scala:34,46), a Query answered
+        collectively with bucketed parameters (FlinkNetwork.scala:196-231),
+        and a Delete that removes its pipeline from the final report."""
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "reqs.jsonl"
+        final = tmp_path / "final_reqs.jsonl"
+        resp = tmp_path / "responses.jsonl"
+        _write_stream(str(train), n=2000)
+        bad_learner = json.dumps({
+            "id": 5, "request": "Create",
+            "learner": {"name": "NoSuchLearner",
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {"protocol": "Synchronous"},
+        })
+        sparse_create = json.dumps({
+            "id": 6, "request": "Create",
+            "learner": {"name": "PA",
+                        "dataStructure": {"sparse": True, "nFeatures": 1024}},
+            "trainingConfiguration": {"protocol": "Synchronous"},
+        })
+        reqs.write_text("\n".join([
+            _create(0), _create(1, protocol="EASGD"),
+            bad_learner, sparse_create,
+        ]) + "\n")
+        final.write_text("\n".join([
+            json.dumps({"id": 0, "request": "Query", "requestId": 7}),
+            json.dumps({"id": 1, "request": "Delete"}),
+        ]) + "\n")
+        report, _, err = _launch(
+            tmp_path, 2,
+            ["--requests", str(reqs), "--trainingData", str(train),
+             "--requestsFinal", str(final), "--responsesOut", str(resp)],
+            "ctrl",
+        )
+        # invalid learner + sparse Create were rejected WITH a reason
+        assert "rejecting Create for pipeline 5" in err
+        assert "rejecting pipeline 6: sparse" in err
+        # pipeline 1 trained, then was deleted: only pipeline 0 reports
+        assert [s["pipeline"] for s in report["statistics"]] == [0]
+        assert "pipeline 1 deleted" in err
+        s0 = _stat(report, 0)
+        assert s0["fitted"] + report["holdout"]["0"] == 2000
+        assert s0["score"] > 0.8
+        # the Query was answered collectively and merged on process 0
+        [resp_line] = resp.read_text().strip().splitlines()
+        q = json.loads(resp_line)
+        assert q["responseId"] == 7
+        assert q["mlpId"] == 0
+        assert q["dataFitted"] == s0["fitted"]
+        assert q["learner"]["name"] == "PA"
+        params = q["learner"]["parameters"]["values"]
+        assert len(params) >= 12 and np.isfinite(params).all()
+        assert q["score"] is not None
+
+    def test_checkpoint_resume_matches_unfaulted(self, tmp_path):
+        """Kill both processes mid-stream (deterministic injected fault at
+        the same chunk), relaunch with --restore: the resumed run must
+        reproduce the unfaulted run's fitted/holdout counts and score —
+        the distributed form of restore-from-checkpoint
+        (FlinkSpoke.scala:233-334)."""
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "reqs.jsonl"
+        ckpt = tmp_path / "ckpts"
+        _write_stream(str(train), n=3000, forecast_every=50)
+        reqs.write_text(_create() + "\n")
+        base = [
+            "--requests", str(reqs), "--trainingData", str(train),
+            "--chunkRows", "256",
+        ]
+        clean, clean_preds, _ = _launch(tmp_path, 2, base, "clean")
+        # faulted attempt: checkpoints at chunks 2 & 4, dies after chunk 5
+        _launch(
+            tmp_path, 2,
+            base + ["--checkpointDir", str(ckpt), "--checkpointEvery", "2",
+                    "--failAfterChunks", "5"],
+            "faulted", expect_rc=3,
+        )
+        assert (ckpt / "LATEST").exists()
+        resumed, res_preds, err = _launch(
+            tmp_path, 2,
+            base + ["--checkpointDir", str(ckpt), "--restore", "true"],
+            "resumed",
+        )
+        assert "restored; resuming at row" in err
+        sc, sr = _stat(clean, 0), _stat(resumed, 0)
+        assert sr["fitted"] == sc["fitted"]
+        assert resumed["holdout"]["0"] == clean["holdout"]["0"]
+        # identical step sequence -> float-equal score
+        assert abs(sr["score"] - sc["score"]) < 1e-6
+        assert len(res_preds) == len(clean_preds)
+
+    def test_kafka_partition_ingest(self, tmp_path):
+        """Each process consumes an ASSIGNED set of Kafka partitions
+        (partition index mod nproc — Flink's static per-subtask
+        assignment) from a file-backed broker fake real processes share;
+        the Create arrives on the requests topic; row counts conserve."""
+        sys.path.insert(0, TESTS)
+        import fskafka
+
+        broker = tmp_path / "broker"
+        os.environ["FSKAFKA_DIR"] = str(broker)
+        try:
+            lines, _ = _rows(2000, 12)
+            for i, line in enumerate(lines):
+                fskafka.append("trainingData", line, partition=i % 4)
+            fskafka.append("requests", _create())
+        finally:
+            os.environ.pop("FSKAFKA_DIR", None)
+        report, _, err = _launch(
+            tmp_path, 2, ["--kafkaBrokers", "fs://local"],
+            "kafka", boot=FSKAFKA_BOOT,
+            env_extra={"FSKAFKA_DIR": str(broker)},
+        )
+        s = _stat(report, 0)
+        assert s["fitted"] + report["holdout"]["0"] == 2000
+        assert s["score"] > 0.8
+
+    def test_kafka_offset_resume(self, tmp_path):
+        """Crash mid-consumption with per-partition offsets checkpointed;
+        the resumed deployment seeks each assigned partition back to its
+        snapshot offset — no row lost, none double-trained (conservation
+        exact)."""
+        sys.path.insert(0, TESTS)
+        import fskafka
+
+        broker = tmp_path / "broker"
+        os.environ["FSKAFKA_DIR"] = str(broker)
+        try:
+            lines, _ = _rows(2000, 12, seed=3)
+            for i, line in enumerate(lines):
+                fskafka.append("trainingData", line, partition=i % 4)
+            fskafka.append("requests", _create())
+        finally:
+            os.environ.pop("FSKAFKA_DIR", None)
+        ckpt = tmp_path / "kafka_ckpts"
+        base = ["--kafkaBrokers", "fs://local", "--chunkRows", "300",
+                "--checkpointDir", str(ckpt)]
+        _launch(
+            tmp_path, 2, base + ["--checkpointEvery", "1",
+                                 "--failAfterChunks", "2"],
+            "kafka_fault", boot=FSKAFKA_BOOT,
+            env_extra={"FSKAFKA_DIR": str(broker)}, expect_rc=3,
+        )
+        assert (ckpt / "LATEST").exists()
+        report, _, err = _launch(
+            tmp_path, 2, base + ["--restore", "true"],
+            "kafka_resumed", boot=FSKAFKA_BOOT,
+            env_extra={"FSKAFKA_DIR": str(broker)},
+        )
+        assert "restored; resuming at offsets" in err
+        # request-topic offsets were checkpointed too: the restore must NOT
+        # replay the Create (a replayed Update would wipe restored state)
+        assert "already exists" not in err
+        s = _stat(report, 0)
+        assert s["fitted"] + report["holdout"]["0"] == 2000
+        assert s["score"] > 0.8
+
+
+@pytest.mark.slow
+def test_unified_cli_single_process(tmp_path):
+    """`python -m omldm_tpu --processes 1 ...` reaches the distributed
+    deployment through the ONE entry point (Job.scala:110-120)."""
+    train = tmp_path / "train.jsonl"
+    reqs = tmp_path / "reqs.jsonl"
+    perf = tmp_path / "perf.jsonl"
+    _write_stream(str(train), n=600)
+    reqs.write_text(_create() + "\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu",
+         "--processes", "1",
+         "--requests", str(reqs), "--trainingData", str(train),
+         "--performanceOut", str(perf),
+         "--batchSize", "64", "--testSetSize", "32"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    report = json.loads(perf.read_text().strip())
+    assert report["processes"] == 1
+    s = _stat(report, 0)
+    assert s["fitted"] + report["holdout"]["0"] == 600
