@@ -175,6 +175,7 @@ class DistributedStreamJob:
         self.pipeline_manager = PipelineManager()
         self.pipelines: Dict[int, _DistPipeline] = {}
         self.dim: Optional[int] = None  # stream width, set by first deploy
+        self.hash_dims = 0  # trailing hashed-categorical slots within dim
         self.responses: List[QueryResponse] = []
         self.response_merger = ResponseMerger(self.responses.append)
         self.orphan_predictions: List[Tuple[int, float]] = []
@@ -368,8 +369,16 @@ class DistributedStreamJob:
         except ValueError as exc:
             self._warn(f"rejecting pipeline {request.id}: {exc}")
             return
+        hash_dims = int(tc.extra.get("hashDims", 0))
+        if self.dim is not None and hash_dims != self.hash_dims:
+            self._warn(
+                f"rejecting pipeline {request.id}: hashDims {hash_dims} != "
+                f"stream hashDims {self.hash_dims} pinned by the first deploy"
+            )
+            return
         self.pipeline_manager.admit(request)
         self.dim = dim
+        self.hash_dims = hash_dims
         if request.id in self.pipelines:
             self._warn(
                 f"pipeline {request.id} replaced by "
@@ -968,6 +977,22 @@ class DistributedStreamJob:
             _atomic_write_bytes(
                 os.path.join(root, "LATEST"), f"ckpt-{k}".encode()
             )
+            # retention: prune superseded snapshots (same policy as the
+            # single-process CheckpointManager's keep/prune,
+            # checkpoint/checkpoint.py) — only LATEST is ever restored,
+            # a couple of spares survive a torn write of the newest
+            keep = max(getattr(self.config, "checkpoint_keep", 3), 1)
+            import shutil
+
+            for name in os.listdir(root):
+                if not name.startswith("ckpt-"):
+                    continue
+                try:
+                    seq = int(name.split("-", 1)[1])
+                except ValueError:
+                    continue
+                if seq <= k - keep:
+                    shutil.rmtree(os.path.join(root, name), ignore_errors=True)
         self.barrier()  # nobody races ahead of the visible pointer
         return d
 
@@ -1105,7 +1130,7 @@ def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     chunk_idx = 0
     chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
     for bx, by, bop in iter_file_batches(
-        flags["trainingData"], job.dim, chunk_rows
+        flags["trainingData"], job.dim, chunk_rows, job.hash_dims
     ):
         n = bx.shape[0]
         if cursor + n <= resume_cursor:
@@ -1202,38 +1227,40 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         except Exception:
             saved_offsets[_tp_key(tp)] = 0
 
-    # partition -> process assignment over the union of the data topics'
-    # partitions (consumer-group semantics without a broker coordinator).
-    # Process 0's metadata view is AUTHORITATIVE and travels over the
-    # fabric: independently-retried partitions_for_topic views can diverge
-    # on freshly-created topics, which would silently double-assign or
-    # drop partitions if each process striped its own list. While the list
-    # is still empty (topics not yet auto-created — the supported
-    # late-start pattern the startup idle bound waits through), the drive
-    # loop re-runs this until partitions appear.
+    # partition -> process assignment: partition p of topic t belongs to
+    # process p % nproc (Flink's static per-subtask assignment, PER TOPIC
+    # so a topic discovered later never shifts an earlier topic's
+    # striping). Process 0's metadata view is AUTHORITATIVE and travels
+    # over the fabric: independently-retried partitions_for_topic views
+    # can diverge on freshly-created topics, which would silently
+    # double-assign or drop partitions if each process striped its own
+    # list. Topics still absent (auto-created later — the supported
+    # late-start pattern the startup idle bound waits through) are
+    # re-probed every window until found, INDEPENDENTLY per topic.
     assigned: List[Any] = []
-    discovered = [False]  # the GLOBAL list was non-empty (broadcast-agreed)
+    undiscovered = [train_topic, fore_topic]
 
     def _assign_partitions(retries: int) -> None:
         assign_payload: List[str] = []
         if job.pid == 0:
-            all_tps0 = []
-            for topic in (train_topic, fore_topic):
-                for pnum in _partitions(consumer, topic, retries):
-                    all_tps0.append([topic, pnum])
-            assign_payload = [json.dumps({"assign": all_tps0})]
+            found = {
+                topic: _partitions(consumer, topic, retries)
+                for topic in undiscovered
+            }
+            assign_payload = [json.dumps({"assign": found})]
         [assign_line] = job._broadcast_lines(assign_payload)
-        all_tps = [
-            TopicPartition(t, p)
-            for t, p in json.loads(assign_line)["assign"]
-        ]
-        if not all_tps:
-            return
-        discovered[0] = True
-        assigned.extend(
-            tp for i, tp in enumerate(all_tps) if i % job.nproc == job.pid
-        )
-        if assigned:
+        found = json.loads(assign_line)["assign"]
+        changed = False
+        for topic, parts in found.items():
+            if not parts:
+                continue
+            undiscovered.remove(topic)
+            changed = True
+            assigned.extend(
+                TopicPartition(topic, p)
+                for p in parts if p % job.nproc == job.pid
+            )
+        if changed and assigned:
             consumer.assign(assigned)
             for tp in assigned:
                 _seek_or_resume(consumer, tp, offsets)
@@ -1242,20 +1269,30 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     # process 0 owns the request topic (single-partition control stream);
     # its offsets are checkpointed too — replaying the whole topic on a
     # restore would re-run Updates (wiping the restored model) and
-    # re-answer Queries
+    # re-answer Queries. Like the data topics, a requests topic
+    # auto-created after launch is re-probed each window.
     req_consumer = None
+    req_assigned = [False]
     if job.pid == 0:
         req_consumer = KafkaConsumer(
             bootstrap_servers=brokers, consumer_timeout_ms=poll_ms
         )
+
+    def _assign_requests(retries: int) -> None:
+        # process-0-local (no collective): only it polls the topic
+        if req_consumer is None or req_assigned[0]:
+            return
         req_tps = [
             TopicPartition(req_topic, p)
-            for p in _partitions(req_consumer, req_topic)
+            for p in _partitions(req_consumer, req_topic, retries)
         ]
         if req_tps:
+            req_assigned[0] = True
             req_consumer.assign(req_tps)
             for tp in req_tps:
                 _seek_or_resume(req_consumer, tp, req_offsets)
+
+    _assign_requests(retries=5)
 
     chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
     # batchers are built once the stream width is known (the first Create
@@ -1266,8 +1303,12 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
 
     def _ensure_batchers():
         if not batchers and job.dim is not None:
-            batchers[train_topic] = PackedBatcher(job.dim, chunk_rows)
-            batchers[fore_topic] = PackedBatcher(job.dim, chunk_rows)
+            batchers[train_topic] = PackedBatcher(
+                job.dim, chunk_rows, job.hash_dims
+            )
+            batchers[fore_topic] = PackedBatcher(
+                job.dim, chunk_rows, job.hash_dims
+            )
         return bool(batchers)
 
     def _feed(topic, batches):
@@ -1291,6 +1332,7 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         # 1. control plane: new request lines, broadcast to everyone
         req_lines: List[str] = []
         if req_consumer is not None:
+            _assign_requests(retries=1)
             while True:
                 try:
                     rec = next(req_consumer)
@@ -1306,12 +1348,17 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         # launch get assigned once their metadata appears (single attempt
         # per window; the decision to re-try is broadcast-agreed, so every
         # process keeps issuing the same collectives)
-        if not discovered[0]:
+        if undiscovered:
             _assign_partitions(retries=1)
-        # 2. data: drain this window's records from the assigned partitions
+        # 2. data: drain this window's records from the assigned
+        # partitions. Record values are ACCUMULATED into one line buffer
+        # per topic and parsed with a single bulk C call per topic per
+        # window — per-record feed_buffer calls would pay a Python/ctypes
+        # round trip per line and forfeit the block parser.
         had_rows = 0
         polled = 0
-        while _ensure_batchers() and polled < chunk_rows:
+        win_bufs = {t: bytearray() for t in batchers} if _ensure_batchers() else {}
+        while win_bufs and polled < chunk_rows:
             try:
                 rec = next(consumer)
             except StopIteration:
@@ -1319,15 +1366,16 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
             polled += 1
             had_rows = 1
             offsets[_tp_key(rec)] = rec.offset + 1
-            v = rec.value
-            line = v if isinstance(v, bytes) else str(v).encode()
-            b = batchers.get(rec.topic)
-            if b is None:
+            wb = win_bufs.get(rec.topic)
+            if wb is None:
                 continue
-            buf = bytearray(line)
-            if not buf.endswith(b"\n"):
-                buf += b"\n"
-            _feed(rec.topic, b.feed_buffer(buf, 0, len(buf)))
+            v = rec.value
+            wb += v if isinstance(v, bytes) else str(v).encode()
+            if not wb.endswith(b"\n"):
+                wb += b"\n"
+        for topic, wb in win_bufs.items():
+            if wb:
+                _feed(topic, batchers[topic].feed_buffer(wb, 0, len(wb)))
         for topic, b in batchers.items():
             tail = b.flush()
             if tail:
