@@ -238,6 +238,9 @@ def _longctx_bench(name, steps, max_len, b, t):
     cfg = TransformerConfig(
         vocab_size=8192, d_model=512, n_heads=4, n_layers=4, d_ff=2048,
         max_len=max_len, dtype=jnp.bfloat16,  # fp32 master, bf16 compute
+        # fused chunked cross-entropy: never materializes the [B*L, 8192]
+        # f32 logits (the dominant non-attention HBM traffic of this model)
+        loss_chunk=1024,
     )
     trainer = SeqTrainer(cfg, mesh=make_seq_mesh(1, 1, 1), lr=1e-3)
     rng = np.random.RandomState(0)

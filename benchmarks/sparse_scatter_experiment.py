@@ -85,7 +85,8 @@ def timed(name, fn, steps, idx, u, w0):
     rate = steps * N / best
     print(
         f"{name:18s} {best:7.3f}s for {steps} steps -> "
-        f"{rate / 1e6:8.1f}M updates/s  ({rate / K / 1e6:6.2f}M ex/s at K={K})"
+        f"{rate / 1e6:8.1f}M updates/s  ({rate / K / 1e6:6.2f}M ex/s at K={K})",
+        flush=True,
     )
     return rate
 
@@ -100,25 +101,11 @@ D_PAD = R_ROWS * C_LANES
 
 
 def mxu_kron_bf16x2(w, idx, u):
-    hi = idx // C_LANES
-    lo = idx % C_LANES
-    a = jax.nn.one_hot(hi, R_ROWS, dtype=jnp.bfloat16)          # [N, R]
-    lo_oh = jax.nn.one_hot(lo, C_LANES, dtype=jnp.float32)      # [N, C]
-    u_hi = u.astype(jnp.bfloat16).astype(jnp.float32)
-    u_lo = u - u_hi
-    b = jnp.concatenate(
-        [
-            (lo_oh * u_hi[:, None]).astype(jnp.bfloat16),
-            (lo_oh * u_lo[:, None]).astype(jnp.bfloat16),
-        ],
-        axis=0,
-    )                                                            # [2N, C]
-    a2 = jnp.concatenate([a, a], axis=0)                         # [2N, R]
-    delta = jax.lax.dot_general(
-        a2, b, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                            # [R, C]
-    return w + delta.reshape(-1)[:D] if w.shape[0] == D else w + delta.reshape(-1)
+    """The SHIPPED kernel (ops/sparse.py:sparse_scatter_add_mxu), driven
+    through its library entry so the measurement covers production code."""
+    from omldm_tpu.ops.sparse import sparse_scatter_add_mxu
+
+    return sparse_scatter_add_mxu(w, idx[:, None], u, jnp.ones_like(u)[:, None])
 
 
 def mxu_kron_f32(w, idx, u):
@@ -131,6 +118,71 @@ def mxu_kron_f32(w, idx, u):
         preferred_element_type=jnp.float32,
     )
     return w + delta.reshape(-1)[:D] if w.shape[0] == D else w + delta.reshape(-1)
+
+
+PALLAS_BLOCK = 1024
+PALLAS_LANES = 128
+
+
+def pallas_serial(w, idx, u):
+    """Pallas: the whole w lives in VMEM as [R8, 128] (1 MB at 2^18) and a
+    serial loop applies each update as a dynamic-row read-modify-write
+    with a 128-lane one-hot add. This measures the SERIALIZATION bound of
+    exact scatter with zero HBM traffic per update — if this lands near
+    XLA's ~66M updates/s, the ceiling is RMW serialization, not memory."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = jax.default_backend() != "tpu"
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    extra = {} if interpret or params_cls is None else {
+        "compiler_params": params_cls(dimension_semantics=("arbitrary",))
+    }
+    d = w.shape[0]
+    rows = -(-d // PALLAS_LANES)
+    n = idx.shape[0]
+    w2 = jnp.zeros((rows * PALLAS_LANES,), w.dtype).at[:d].set(w)
+    w2 = w2.reshape(rows, PALLAS_LANES)
+
+    def kernel(idx_ref, u_ref, w_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            w_ref[...] = jnp.zeros_like(w_ref)
+
+        lanes = jax.lax.broadcasted_iota(
+            jnp.int32, (1, PALLAS_LANES), 1
+        )
+
+        def body(i, _):
+            t = idx_ref[pl.ds(i, 1)][0]
+            uu = u_ref[pl.ds(i, 1)][0]
+            r = t // PALLAS_LANES
+            l = t % PALLAS_LANES
+            row = w_ref[pl.ds(r, 1), :]
+            row = row + jnp.where(lanes == l, uu, 0.0)
+            w_ref[pl.ds(r, 1), :] = row
+            return 0
+
+        jax.lax.fori_loop(0, PALLAS_BLOCK, body, 0)
+
+    grid = n // PALLAS_BLOCK
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((PALLAS_BLOCK,), lambda g: (g,)),
+            pl.BlockSpec((PALLAS_BLOCK,), lambda g: (g,)),
+        ],
+        # constant index_map: the accumulator block stays resident in
+        # VMEM across every grid step (initialized at step 0 above)
+        out_specs=pl.BlockSpec((rows, PALLAS_LANES), lambda g: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, PALLAS_LANES), w.dtype),
+        interpret=interpret,
+        **extra,
+    )(idx[: grid * PALLAS_BLOCK], u[: grid * PALLAS_BLOCK])
+    return (w2 + out).reshape(-1)[:d]
 
 
 def sort_segment(w, idx, u):
@@ -165,23 +217,28 @@ def main():
     # numerical parity first (sum of exact products, reordered)
     ref = np.zeros(D, np.float32)
     np.add.at(ref, np.asarray(idx), np.asarray(u))
-    for name, fn in [
-        ("xla-scatter", xla_scatter),
-        ("mxu-kron-bf16x2", mxu_kron_bf16x2),
-        ("mxu-kron-f32", mxu_kron_f32),
-        ("sort-segment", sort_segment),
-    ]:
-        out = np.asarray(jax.jit(fn)(w0, idx, u))
+    candidates = [
+        ("xla-scatter", xla_scatter, 64),
+        ("mxu-kron-bf16x2", mxu_kron_bf16x2, 256),
+        ("mxu-kron-f32", mxu_kron_f32, 64),
+        ("sort-segment", sort_segment, 64),
+        ("pallas-serial", pallas_serial, 16),
+    ]
+    for name, fn, _ in candidates:
+        try:
+            out = np.asarray(jax.jit(fn)(w0, idx, u))
+        except Exception as exc:
+            print(f"parity {name:18s} FAILED: {exc}", flush=True)
+            continue
         err = np.max(np.abs(out - ref)) / max(np.max(np.abs(ref)), 1e-9)
         print(f"parity {name:18s} max rel err {err:.2e}", flush=True)
 
     rates = {}
-    rates["xla-scatter"] = timed("xla-scatter", xla_scatter, 64, idx, u, w0)
-    rates["mxu-kron-bf16x2"] = timed(
-        "mxu-kron-bf16x2", mxu_kron_bf16x2, 256, idx, u, w0
-    )
-    rates["mxu-kron-f32"] = timed("mxu-kron-f32", mxu_kron_f32, 64, idx, u, w0)
-    rates["sort-segment"] = timed("sort-segment", sort_segment, 64, idx, u, w0)
+    for name, fn, steps in candidates:
+        try:
+            rates[name] = timed(name, fn, steps, idx, u, w0)
+        except Exception as exc:
+            print(f"{name:18s} FAILED: {type(exc).__name__}", flush=True)
 
     print("\nroofline:")
     flop_per_upd = 2 * 2 * D_PAD / 1.0  # bf16x2: two 2*D_pad-FLOP addends

@@ -29,7 +29,7 @@ from omldm_tpu.ops.sparse import (
     append_bias_sparse,
     sparse_matmat,
     sparse_matvec,
-    sparse_scatter_add,
+    sparse_scatter_add_auto,
     sparse_scatter_add_outer,
     sparse_sq_norm,
 )
@@ -92,7 +92,7 @@ class SparsePAClassifier(SparseLinear):
         tau = _pa_tau(hinge, sparse_sq_norm(val), variant, C)
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         coef = tau * ys * mask / denom
-        w = sparse_scatter_add(params["w"], idx, coef, val)
+        w = sparse_scatter_add_auto(params["w"], idx, coef, val)
         return {"w": w}, masked_mean(hinge, mask)
 
 
@@ -121,7 +121,7 @@ class SparsePARegressor(SparseLinear):
         tau = _pa_tau(l, sparse_sq_norm(val), variant, C)
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         coef = -jnp.sign(err) * tau * mask / denom
-        w = sparse_scatter_add(params["w"], idx, coef, val)
+        w = sparse_scatter_add_auto(params["w"], idx, coef, val)
         return {"w": w}, masked_mean(l, mask)
 
 
@@ -160,7 +160,7 @@ class SparseSVM(SparseLinear):
         eta = 1.0 / (lam * params["t"])
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         w = params["w"] * (1.0 - eta * lam)
-        w = sparse_scatter_add(w, idx, eta * ys * viol / denom, val)
+        w = sparse_scatter_add_auto(w, idx, eta * ys * viol / denom, val)
         return (
             {"w": w, "t": params["t"] + 1.0},
             masked_mean(hinge, mask),

@@ -23,7 +23,7 @@ this is the framework's long-context scope, designed TPU-first.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +55,14 @@ class TransformerConfig:
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(n_layers) less HBM —
     # the standard long-context memory lever
     remat: bool = False
+    # fused chunked LM cross-entropy: > 0 computes the loss in token
+    # chunks of this size — logits for a chunk are produced by a bf16
+    # matmul with f32 accumulation, reduced to (lse, target-logit) and
+    # DISCARDED; the backward recomputes them per chunk (jax.checkpoint
+    # over a lax.scan). The full [B*L, V] f32 logits tensor (the HBM
+    # round-trip that dominates the non-attention time at V=8192) is
+    # never materialized. 0 = unfused (whole-tensor log_softmax).
+    loss_chunk: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -261,14 +269,14 @@ def _moe_block_ep(layer, x, ep_axis: str, capacity_factor: float):
     return out_t.reshape(b, lc, d)
 
 
-def transformer_forward(
+def transformer_hidden(
     cfg: TransformerConfig,
     params: Dict[str, Any],
     tokens: jnp.ndarray,          # [B, Lc] int32 (local chunk when sp)
     axes: AxisSpec = AxisSpec(),
-) -> jnp.ndarray:
-    """Returns token logits [B, Lc, V] ("lm") or pooled class logits
-    [B, n_classes] ("classify")."""
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Final-norm hidden states [B, Lc, D] plus the compute-dtype params
+    (so loss heads reuse the cast instead of re-casting)."""
     params = cast_params(params, cfg.dtype)
     b, lc = tokens.shape
     pos_offset = jax.lax.axis_index(axes.sp) * lc if axes.sp else 0
@@ -293,6 +301,18 @@ def transformer_forward(
     for layer in params["layers"]:
         x = block(x, layer)
     x = _rms_norm(x, params["ln_f"]["g"])
+    return x, params
+
+
+def transformer_forward(
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,          # [B, Lc] int32 (local chunk when sp)
+    axes: AxisSpec = AxisSpec(),
+) -> jnp.ndarray:
+    """Returns token logits [B, Lc, V] ("lm") or pooled class logits
+    [B, n_classes] ("classify")."""
+    x, params = transformer_hidden(cfg, params, tokens, axes)
     if cfg.objective == "classify":
         pooled = jnp.mean(x, axis=1)                       # local mean over Lc
         if axes.sp:
@@ -302,15 +322,67 @@ def transformer_forward(
     return x @ params["head"]                              # [B, Lc, V]
 
 
+def _lm_nll_fused(head, x, targets, mask, chunk):
+    """Masked NLL sum over all local tokens WITHOUT materializing the
+    [T, V] logits: lax.scan over token chunks, each chunk's logits built
+    by a bf16 matmul with f32 accumulation, reduced to (logsumexp,
+    target logit) and dropped; jax.checkpoint recomputes them in the
+    backward, where dlogits -> (dx, dhead) contract chunk-locally. The
+    V=8192 head's f32 logits tensor — 2 full HBM round trips forward and
+    more backward in the unfused form — never exists."""
+    d = x.shape[-1]
+    xs = x.reshape(-1, d)
+    ts = targets.reshape(-1).astype(jnp.int32)
+    ms = mask.reshape(-1).astype(jnp.float32)
+    t_total = xs.shape[0]
+    n_chunks = -(-t_total // chunk)
+    pad = n_chunks * chunk - t_total
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad, d), xs.dtype)])
+        ts = jnp.concatenate([ts, jnp.zeros((pad,), ts.dtype)])
+        ms = jnp.concatenate([ms, jnp.zeros((pad,), ms.dtype)])
+    xs = xs.reshape(n_chunks, chunk, d)
+    ts = ts.reshape(n_chunks, chunk)
+    ms = ms.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def body(acc, inp):
+        xc, tc, mc = inp
+        logits = jnp.dot(
+            xc, head, preferred_element_type=jnp.float32
+        )                                                  # [chunk, V] f32
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return acc + jnp.sum((lse - tl) * mc), None
+
+    # carry derived from the data so it has the same varying-axes type as
+    # the body output under shard_map (a plain 0.0 literal is unvarying
+    # and scan rejects the carry-type mismatch)
+    acc0 = jnp.sum(ms) * jnp.float32(0.0)
+    total, _ = jax.lax.scan(body, acc0, (xs, ts, ms))
+    return total
+
+
 def lm_loss(cfg, params, tokens, targets, mask, axes: AxisSpec = AxisSpec()):
     """GLOBAL mean next-token cross-entropy. targets/mask are pre-shifted
     host-side and sharded like tokens; the mean reduces over the dp and sp
-    axes so every shard returns the same scalar."""
-    logits = transformer_forward(cfg, params, tokens, axes)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    num = jnp.sum(nll * mask)
-    den = jnp.sum(mask)
+    axes so every shard returns the same scalar. With ``cfg.loss_chunk``
+    the NLL is computed by the fused chunked head (no [T, V] logits in
+    HBM); numerics match the unfused path to f32 accumulation order —
+    tighter, in fact: the unfused path rounds logits to bf16 before the
+    f32 log_softmax."""
+    if cfg.loss_chunk > 0:
+        x, cparams = transformer_hidden(cfg, params, tokens, axes)
+        num = _lm_nll_fused(
+            cparams["head"], x, targets, mask, cfg.loss_chunk
+        )
+        den = jnp.sum(mask)
+    else:
+        logits = transformer_forward(cfg, params, tokens, axes)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        num = jnp.sum(nll * mask)
+        den = jnp.sum(mask)
     for ax in axes.loss_axes():
         num = jax.lax.psum(num, ax)
         den = jax.lax.psum(den, ax)

@@ -18,6 +18,7 @@ TPU and the surrounding elementwise work fuses.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 SparseBatch = tuple  # (idx[B, K] int32, val[B, K] float32)
@@ -41,6 +42,85 @@ def sparse_scatter_add(
     indices accumulate, including the idx=0 pad slots whose val is 0)."""
     upd = (coef[:, None] * val).reshape(-1)
     return w.at[idx.reshape(-1)].add(upd)
+
+
+# lane width of the kron factorization below: the TPU register/MXU lane
+# count, so the one-hot matmul operands tile exactly
+MXU_LANES = 512
+
+
+def sparse_scatter_add_mxu(
+    w: jnp.ndarray, idx: jnp.ndarray, coef: jnp.ndarray, val: jnp.ndarray
+) -> jnp.ndarray:
+    """The SAME scatter-add as :func:`sparse_scatter_add`, reformulated as
+    ONE MXU contraction — XLA's TPU scatter serializes randomly-indexed
+    updates at ~66M/s (measured, benchmarks/sparse_scatter_experiment.py)
+    while the systolic array is idle; this trades FLOPs for that
+    serialization.
+
+    Factor the index space D <= R*C as (hi, lo) = divmod(idx, C) with
+    C = 512 lanes. The scattered delta, viewed as a [R, C] matrix, is a
+    sum of rank-1 one-hot outer products — i.e. one matmul over the
+    update dimension n:
+
+        delta[hi, lo] = sum_n u_n * e(hi_n) (x) e(lo_n)
+                      = OneHotHi[n, R]^T @ (OneHotLo[n, C] * u_n)
+
+    Numerics: one-hot entries are exact in bf16; u is split
+    u = bf16(u) + bf16(u - bf16(u)) and the two halves are CONCATENATED
+    along the contraction dim. The high half's products are exact; the
+    low-half residual is itself rounded to bf16, leaving a bounded
+    ~2^-17 relative error per update ON TOP of the f32 accumulation
+    reorder — close to, but not exactly, scatter-bit-equivalence (pinned
+    to 2e-5 against the scatter by tests/test_sparse.py).
+
+    Cost: 2 * 2 * R*C FLOPs per update — at D = 2^18 that is ~1 MFLOP
+    per scattered update, so the MXU formulation pays for itself exactly
+    when the chip's matmul rate beats 66M * 2^20 FLOP/s; see the
+    experiment's roofline section for where the crossover lands.
+
+    Reference counterpart: SparseVector updates in the reference's data
+    model (DataPointParser.scala:4,20-47) — the reference applies them
+    element-by-element on the JVM; this is the TPU-native form.
+    """
+    d = w.shape[0]
+    c = MXU_LANES
+    r = -(-d // c)
+    n = idx.size
+    flat_idx = idx.reshape(n)
+    u = (coef[:, None] * val).reshape(n).astype(jnp.float32)
+    hi = flat_idx // c
+    lo = flat_idx % c
+    one_hi = jax.nn.one_hot(hi, r, dtype=jnp.bfloat16)            # [n, R]
+    lo_oh = jax.nn.one_hot(lo, c, dtype=jnp.float32)              # [n, C]
+    u_hi = u.astype(jnp.bfloat16).astype(jnp.float32)
+    u_lo = u - u_hi
+    rhs = jnp.concatenate(
+        [
+            (lo_oh * u_hi[:, None]).astype(jnp.bfloat16),
+            (lo_oh * u_lo[:, None]).astype(jnp.bfloat16),
+        ],
+        axis=0,
+    )                                                              # [2n, C]
+    lhs = jnp.concatenate([one_hi, one_hi], axis=0)                # [2n, R]
+    delta = jax.lax.dot_general(
+        lhs, rhs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                              # [R, C]
+    flat = delta.reshape(-1)
+    return w + (flat[:d] if r * c != d else flat)
+
+
+def sparse_scatter_add_auto(
+    w: jnp.ndarray, idx: jnp.ndarray, coef: jnp.ndarray, val: jnp.ndarray
+) -> jnp.ndarray:
+    """Backend dispatch (resolved at trace time): the MXU reformulation on
+    TPU at the hashed widths where XLA's serialized scatter is the
+    bottleneck; the plain scatter elsewhere (CPU tests, narrow models
+    where the one-hot FLOPs dominate)."""
+    if jax.default_backend() == "tpu" and w.shape[0] >= (1 << 16):
+        return sparse_scatter_add_mxu(w, idx, coef, val)
+    return sparse_scatter_add(w, idx, coef, val)
 
 
 def sparse_scatter_add_outer(

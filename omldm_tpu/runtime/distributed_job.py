@@ -1239,6 +1239,12 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     # re-probed every window until found, INDEPENDENTLY per topic.
     assigned: List[Any] = []
     undiscovered = [train_topic, fore_topic]
+    # rotating stripe base: partition p of the i-th discovered partition
+    # group goes to process (p + base) % nproc, base advancing by each
+    # group's size — so single-partition topics SPREAD across processes
+    # instead of all landing on process 0. Discovery events arrive in
+    # broadcast order, so every process advances the base identically.
+    stripe_base = [0]
 
     def _assign_partitions(retries: int) -> None:
         assign_payload: List[str] = []
@@ -1251,14 +1257,18 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         [assign_line] = job._broadcast_lines(assign_payload)
         found = json.loads(assign_line)["assign"]
         changed = False
-        for topic, parts in found.items():
+        # iterate in the stable (train, fore) order, not dict order
+        for topic in [t for t in (train_topic, fore_topic) if t in found]:
+            parts = found[topic]
             if not parts:
                 continue
             undiscovered.remove(topic)
             changed = True
+            base = stripe_base[0]
+            stripe_base[0] += len(parts)
             assigned.extend(
                 TopicPartition(topic, p)
-                for p in parts if p % job.nproc == job.pid
+                for p in parts if (p + base) % job.nproc == job.pid
             )
         if changed and assigned:
             consumer.assign(assigned)

@@ -503,10 +503,14 @@ class SPMDBridge:
                     pair, n = item
                     if not errors:
                         train(pair[0], pair[1], n)
-                    free.put(pair)
                 except BaseException as exc:  # surfaced to the parse thread
                     errors.append(exc)
                 finally:
+                    # the pair returns to the pool even when train raised —
+                    # a lost pair would leave the parse thread blocked in
+                    # free.get() forever instead of seeing the error
+                    if item is not None:
+                        free.put(item[0])
                     work.task_done()
 
         t = threading.Thread(target=worker, daemon=True)
