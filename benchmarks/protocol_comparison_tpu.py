@@ -1,0 +1,159 @@
+"""Per-protocol cost on the REAL TPU chip (dp=1 x hub=1).
+
+The virtual-mesh comparison (protocol_comparison.py) pins protocol
+SEMANTICS — score parity, traffic accounting — but its examples/sec is
+8-virtual-devices-on-one-CPU-core emulation. This harness measures what
+protocol synchronization actually costs on silicon, in the only
+configuration one chip can host (dp=1, hub=1 — the reference's
+parallelism-1 operating point; dp>1/hub>1 need more chips and are
+validated on the virtual mesh):
+
+- protocol-free baseline: the SAME learner/batch through MLPipeline's
+  chained fit (no parameter-server machinery at all);
+- all 6 collective protocols through SPMDTrainer.step_many_dense at the
+  same shapes: examples/sec, per-step overhead vs the baseline, logical
+  bytesShipped vs physical collective bytes (at dp=1 the fold/sync
+  collectives are single-participant — the overhead measured here is the
+  protocol's control flow: drift norms, votes, clock bookkeeping, the
+  gated branches — the part that rides EVERY deployment).
+
+Tunnel rules: chained steps inside one program, device-resident stages,
+real D2H fetch as the barrier, best-of-3. Emits ONE JSON object and
+writes PROTOCOL_TPU.json for RESULTS_r05. Reference vocabulary:
+FlinkHub.scala:118-127 statistics.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+_cache = os.path.join(os.path.expanduser("~"), ".cache", "omldm_tpu", "xla")
+os.makedirs(_cache, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+DIM = 28
+BATCH = 256
+CHAIN = 64
+ROUNDS = 40  # chained launches per timed sample
+
+PROTOCOLS = ("Synchronous", "Asynchronous", "SSP", "EASGD", "GM", "FGM")
+
+
+def materialize(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(np.asarray(leaves[0]).reshape(-1)[0])
+
+
+def _data(rng):
+    w = np.random.RandomState(42).randn(DIM)
+    x = rng.randn(CHAIN, BATCH, DIM).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return jax.device_put(x), jax.device_put(y)
+
+
+def bench_baseline(xs, ys):
+    """Protocol-free chained fit: MLPipeline (no PS, no collectives)."""
+    from omldm_tpu.api.requests import LearnerSpec
+    from omldm_tpu.pipelines import MLPipeline
+
+    pipe = MLPipeline(
+        LearnerSpec("PA", hyper_parameters={"C": 1.0}), [], dim=DIM,
+        rng=jax.random.PRNGKey(0),
+    )
+    masks = jax.device_put(np.ones((CHAIN, BATCH), np.float32))
+    counts = [BATCH] * CHAIN
+
+    def launch():
+        for _ in range(ROUNDS):
+            pipe.fit_many(xs, ys, masks, valid_counts=counts)
+        materialize(pipe.state["params"])
+
+    launch()  # warm/compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        launch()
+        best = min(best, time.perf_counter() - t0)
+    steps = ROUNDS * CHAIN
+    return {
+        "examples_per_sec": round(steps * BATCH / best, 1),
+        "us_per_step": round(best / steps * 1e6, 2),
+    }
+
+
+def bench_protocol(protocol, xs, ys):
+    from omldm_tpu.api.requests import LearnerSpec, TrainingConfiguration
+    from omldm_tpu.parallel import SPMDTrainer, make_mesh
+
+    extra = {"syncEvery": 4}
+    if protocol in ("GM", "FGM"):
+        extra["threshold"] = 0.5
+    if protocol == "SSP":
+        extra["staleness"] = 3
+    tr = SPMDTrainer(
+        LearnerSpec("PA", hyper_parameters={"C": 1.0}), [], dim=DIM,
+        protocol=protocol, mesh=make_mesh(dp=1, hub=1),
+        training_configuration=TrainingConfiguration(
+            protocol=protocol, extra=extra
+        ),
+        batch_size=BATCH,
+    )
+    xs1 = xs[:, None]  # [CHAIN, dp=1, B, D]
+    ys1 = ys[:, None]
+
+    def launch():
+        for _ in range(ROUNDS):
+            tr.step_many_dense(xs1, ys1)
+        materialize(tr.state["params"])
+
+    launch()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        launch()
+        best = min(best, time.perf_counter() - t0)
+    steps = ROUNDS * CHAIN
+    return {
+        "examples_per_sec": round(steps * BATCH / best, 1),
+        "us_per_step": round(best / steps * 1e6, 2),
+        "bytes_shipped_logical": tr.bytes_shipped(),
+        "bytes_physical": tr.collective_bytes_physical(),
+        "sync_count": tr.sync_count(),
+    }
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.RandomState(0)
+    xs, ys = _data(rng)
+    materialize((xs, ys))
+    out = {"baseline_no_protocol": bench_baseline(xs, ys)}
+    base_us = out["baseline_no_protocol"]["us_per_step"]
+    for protocol in PROTOCOLS:
+        r = bench_protocol(protocol, xs, ys)
+        r["overhead_us_per_step_vs_free"] = round(r["us_per_step"] - base_us, 2)
+        out[protocol] = r
+        print(f"{protocol:14s} {r}", flush=True)
+    doc = {
+        "protocol_comparison_tpu": out,
+        "basis": (
+            f"real chip, dp=1 x hub=1, batch {BATCH}, {CHAIN}-step chained "
+            f"launches x {ROUNDS} rounds, best-of-3; overhead = protocol "
+            "step time minus the protocol-free MLPipeline chained fit at "
+            "identical shapes. dp>1/hub>1 protocol semantics + traffic are "
+            "pinned on the virtual mesh (protocol_comparison.py)"
+        ),
+    }
+    print(json.dumps(doc, indent=1), flush=True)
+    with open(
+        os.path.join(os.path.dirname(__file__), "PROTOCOL_TPU.json"), "w"
+    ) as f:
+        json.dump(doc, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
