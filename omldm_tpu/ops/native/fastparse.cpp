@@ -386,10 +386,36 @@ inline bool ishex(char h) {
          (h >= 'A' && h <= 'F');
 }
 
+// First byte in [p, end) that is a backslash or a raw control char
+// (< 0x20), or ``end`` — SWAR, 8 bytes per iteration. The two classes are
+// exactly what interrupts a plain JSON string span: '\\' starts an escape
+// and controls must be escaped (json.loads parity).
+inline const char* scan_special(const char* p, const char* end) {
+  while (end - p >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    // zero-byte detector on w ^ '\\' -> flags bytes equal to backslash
+    uint64_t x = w ^ 0x5C5C5C5C5C5C5C5CULL;
+    uint64_t bs =
+        (x - 0x0101010101010101ULL) & ~x & 0x8080808080808080ULL;
+    // byte < 0x20: (b - 0x20) borrows into the high bit AND b < 0x80
+    uint64_t lt =
+        (w - 0x2020202020202020ULL) & ~w & 0x8080808080808080ULL;
+    uint64_t hit = bs | lt;
+    if (hit) return p + (__builtin_ctzll(hit) >> 3);
+    p += 8;
+  }
+  for (; p < end; ++p) {
+    unsigned char ch = static_cast<unsigned char>(*p);
+    if (ch == '\\' || ch < 0x20) return p;
+  }
+  return end;
+}
+
 // Strict-JSON string scan (json.loads parity): raw control characters
 // (< 0x20) must be escaped, and only the JSON escapes \" \\ \/ \b \f \n
 // \r \t \uXXXX are valid. Leaves the cursor after the closing quote.
-// Fast shape: memchr to the candidate closing quote, one linear pass over
+// Fast shape: memchr to the candidate closing quote, one SWAR pass over
 // the span; the per-escape state machine only runs from the first
 // backslash onward (strings in this schema rarely contain any).
 inline bool skip_string(Cursor& c) {
@@ -398,12 +424,8 @@ inline bool skip_string(Cursor& c) {
     const char* q =
         static_cast<const char*>(memchr(c.p, '"', c.end - c.p));
     if (!q) return false;
-    const char* s = c.p;
-    for (; s < q; ++s) {
-      unsigned char ch = static_cast<unsigned char>(*s);
-      if (ch < 0x20) return false;
-      if (ch == '\\') break;
-    }
+    const char* s = scan_special(c.p, q);
+    if (s < q && static_cast<unsigned char>(*s) < 0x20) return false;
     if (s == q) {  // clean span: q really is the closing quote
       c.p = q + 1;
       return true;
@@ -789,8 +811,10 @@ struct Crc8Tables {
   }
 };
 
+static const Crc8Tables CRC_T;  // namespace scope: no per-call init guard
+
 inline uint32_t crc32_zlib(const char* data, size_t len, uint32_t seed) {
-  static const Crc8Tables T;
+  const Crc8Tables& T = CRC_T;
   const uint32_t* t0 = T.t[0];
   uint32_t c = seed ^ 0xFFFFFFFFu;
   while (len >= 8) {
@@ -810,10 +834,24 @@ inline uint32_t crc32_zlib(const char* data, size_t len, uint32_t seed) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// Exact x % d via Lemire's fastmod (two multiplies instead of a
+// hardware divide); d is fixed for a whole parse call.
+struct FastMod {
+  uint64_t m;
+  uint32_t d;
+  explicit FastMod(uint32_t d_) : m(~0ULL / d_ + 1), d(d_) {}
+  inline uint32_t mod(uint32_t x) const {
+    uint64_t low = m * x;
+    return static_cast<uint32_t>(
+        (static_cast<unsigned __int128>(low) * d) >> 64);
+  }
+};
+
 // Parse one line into padded-COO row i. Same valid semantics as
 // parse_one_line (0 drop, 1 keep, 2 Python fallback).
 inline void parse_one_line_sparse(const char* p, const char* line_end,
                                   int dense_budget, long hash_space,
+                                  const FastMod& hash_mod,
                                   int max_nnz, int32_t* ii, float* vv,
                                   float* yi, unsigned char* opi,
                                   unsigned char* validi) {
@@ -937,12 +975,16 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
         while (c.p < c.end) {
           if (*c.p != '"') { *validi = 2; return; }  // non-string element
           const char* vs = c.p + 1;
-          if (!skip_string(c)) { ok = false; break; }
-          const char* ve = c.p - 1;
-          if (memchr(vs, '\\', ve - vs) != nullptr) {
-            *validi = 2;  // escaped content: Python decodes + hashes
-            return;
+          const char* ve = static_cast<const char*>(
+              memchr(vs, '"', c.end - vs));
+          if (ve == nullptr) { ok = false; break; }
+          const char* sp = scan_special(vs, ve);
+          if (sp < ve) {
+            if (*sp == '\\') { *validi = 2; return; }  // Python decodes
+            ok = false;  // raw control char: json.loads drops the line
+            break;
           }
+          c.p = ve + 1;
           if (k < max_nnz) {
             // CRC state after the "{i}=" prefix depends only on i: cache
             // it (the prefixes repeat every line). snprintf here once
@@ -972,8 +1014,7 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
               }
             }
             h = crc32_zlib(vs, ve - vs, h);
-            ii[k] = static_cast<int32_t>(
-                dense_budget + (h % static_cast<uint32_t>(hash_space)));
+            ii[k] = static_cast<int32_t>(dense_budget + hash_mod.mod(h));
             vv[k] = ((h >> 1) & 1u) == 0 ? 1.0f : -1.0f;
             ++k;
           }
@@ -1190,10 +1231,13 @@ int omldm_parse_lines_sparse(const char* buf, long len, int dense_budget,
   const char* p = buf;
   const char* bufend = buf + len;
   int i = 0;
+  const FastMod hash_mod(
+      static_cast<uint32_t>(hash_space > 0 ? hash_space : 1));
   while (p < bufend && i < max_records) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
     const char* line_end = nl ? nl : bufend;
-    parse_one_line_sparse(p, line_end, dense_budget, hash_space, max_nnz,
+    parse_one_line_sparse(p, line_end, dense_budget, hash_space, hash_mod,
+                          max_nnz,
                           idx + static_cast<long>(i) * max_nnz,
                           val + static_cast<long>(i) * max_nnz, y + i,
                           op + i, valid + i);
