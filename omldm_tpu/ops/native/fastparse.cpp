@@ -390,28 +390,50 @@ inline bool ishex(char h) {
 // (< 0x20), or ``end`` — SWAR, 8 bytes per iteration. The two classes are
 // exactly what interrupts a plain JSON string span: '\\' starts an escape
 // and controls must be escaped (json.loads parity).
-inline const char* scan_special(const char* p, const char* end) {
+template <bool kWithQuote>
+inline const char* scan_span_impl(const char* p, const char* end) {
   while (end - p >= 8) {
     uint64_t w;
     memcpy(&w, p, 8);
     // zero-byte detector on w ^ '\\' -> flags bytes equal to backslash
     uint64_t x = w ^ 0x5C5C5C5C5C5C5C5CULL;
-    uint64_t bs =
+    uint64_t hit =
         (x - 0x0101010101010101ULL) & ~x & 0x8080808080808080ULL;
     // byte < 0x20: (b - 0x20) borrows into the high bit AND b < 0x80
-    uint64_t lt =
-        (w - 0x2020202020202020ULL) & ~w & 0x8080808080808080ULL;
-    uint64_t hit = bs | lt;
+    hit |= (w - 0x2020202020202020ULL) & ~w & 0x8080808080808080ULL;
+    if (kWithQuote) {
+      uint64_t xq = w ^ 0x2222222222222222ULL;  // zero byte where '"'
+      hit |= (xq - 0x0101010101010101ULL) & ~xq & 0x8080808080808080ULL;
+    }
     if (hit) return p + (__builtin_ctzll(hit) >> 3);
     p += 8;
   }
   for (; p < end; ++p) {
     unsigned char ch = static_cast<unsigned char>(*p);
-    if (ch == '\\' || ch < 0x20) return p;
+    if (ch == '\\' || ch < 0x20 || (kWithQuote && ch == '"')) return p;
   }
   return end;
 }
 
+// First byte in [p, end) that is a backslash or a raw control char
+// (< 0x20), or ``end`` — what interrupts a plain JSON string span whose
+// closing quote is already known.
+inline const char* scan_special(const char* p, const char* end) {
+  return scan_span_impl<false>(p, end);
+}
+
+// Same scan, additionally stopping at '"': finds the closing quote OR
+// the first special byte in ONE pass (memchr-then-rescan costs two
+// passes plus a library call's setup at ~10-byte category strings).
+inline const char* scan_quote_or_special(const char* p, const char* end) {
+  return scan_span_impl<true>(p, end);
+}
+
+// First byte in [p, end) that TERMINATES or interrupts a plain JSON
+// string span — a closing quote, a backslash, or a raw control char —
+// in ONE SWAR pass (memchr-then-rescan costs two passes plus a library
+// call's setup, which dominates at category-string lengths of ~10B).
+// Returns ``end`` if none found.
 // Strict-JSON string scan (json.loads parity): raw control characters
 // (< 0x20) must be escaped, and only the JSON escapes \" \\ \/ \b \f \n
 // \r \t \uXXXX are valid. Leaves the cursor after the closing quote.
@@ -962,7 +984,12 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
       case KEY_CATEGORICAL: {
         if (cat_seen) { *validi = 2; return; }
         cat_seen = true;
-        if (hash_space <= 0) { *validi = 2; return; }
+        // hash_space must fit uint32 for the fastmod (and the old 32-bit
+        // %); larger spaces defer to the full-precision Python hasher
+        if (hash_space <= 0 || hash_space > 0xFFFFFFFFL) {
+          *validi = 2;
+          return;
+        }
         if (c.p >= c.end || *c.p != '[') {
           int r = check_value(c);
           if (r == 0) ok = false; else if (r == 2) { *validi = 2; return; }
@@ -975,12 +1002,10 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
         while (c.p < c.end) {
           if (*c.p != '"') { *validi = 2; return; }  // non-string element
           const char* vs = c.p + 1;
-          const char* ve = static_cast<const char*>(
-              memchr(vs, '"', c.end - vs));
-          if (ve == nullptr) { ok = false; break; }
-          const char* sp = scan_special(vs, ve);
-          if (sp < ve) {
-            if (*sp == '\\') { *validi = 2; return; }  // Python decodes
+          const char* ve = scan_quote_or_special(vs, c.end);
+          if (ve >= c.end) { ok = false; break; }  // unterminated
+          if (*ve != '"') {
+            if (*ve == '\\') { *validi = 2; return; }  // Python decodes
             ok = false;  // raw control char: json.loads drops the line
             break;
           }
@@ -1231,8 +1256,9 @@ int omldm_parse_lines_sparse(const char* buf, long len, int dense_budget,
   const char* p = buf;
   const char* bufend = buf + len;
   int i = 0;
+  const bool hash_fits = hash_space > 0 && hash_space <= 0xFFFFFFFFL;
   const FastMod hash_mod(
-      static_cast<uint32_t>(hash_space > 0 ? hash_space : 1));
+      hash_fits ? static_cast<uint32_t>(hash_space) : 1u);
   while (p < bufend && i < max_records) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
     const char* line_end = nl ? nl : bufend;
