@@ -429,11 +429,6 @@ inline const char* scan_quote_or_special(const char* p, const char* end) {
   return scan_span_impl<true>(p, end);
 }
 
-// First byte in [p, end) that TERMINATES or interrupts a plain JSON
-// string span — a closing quote, a backslash, or a raw control char —
-// in ONE SWAR pass (memchr-then-rescan costs two passes plus a library
-// call's setup, which dominates at category-string lengths of ~10B).
-// Returns ``end`` if none found.
 // Strict-JSON string scan (json.loads parity): raw control characters
 // (< 0x20) must be escaped, and only the JSON escapes \" \\ \/ \b \f \n
 // \r \t \uXXXX are valid. Leaves the cursor after the closing quote.
@@ -869,6 +864,105 @@ struct FastMod {
   }
 };
 
+// Categorical string items (cursor just past '['): hash each plain
+// string into a COO slot. Returns 0 ok (cursor past ']'), 1 malformed
+// (json.loads drops the line), 2 Python fallback (escapes). Shared by
+// the general key walk and the whole-line schema template.
+inline int parse_cat_items(Cursor& c, int dense_budget,
+                           const FastMod& hash_mod, int max_nnz,
+                           int32_t* ii, float* vv, int& k, bool& any) {
+  skip_ws(c);
+  long cat_i = 0;
+  if (c.p < c.end && *c.p == ']') { ++c.p; return 0; }
+  while (c.p < c.end) {
+    if (*c.p != '"') return 2;  // non-string element
+    const char* vs = c.p + 1;
+    const char* ve = scan_quote_or_special(vs, c.end);
+    if (ve >= c.end) return 1;  // unterminated
+    if (*ve != '"') {
+      if (*ve == '\\') return 2;  // escaped content: Python decodes
+      return 1;  // raw control char: json.loads drops the line
+    }
+    c.p = ve + 1;
+    if (k < max_nnz) {
+      // CRC state after the "{i}=" prefix depends only on i: cache it
+      // (the prefixes repeat every line). snprintf here once measured
+      // ~5 us/line; the hand-rolled digits remain for the uncached tail
+      uint32_t h;
+      static thread_local uint32_t prefix_crc[64];
+      static thread_local bool prefix_have[64];
+      if (cat_i < 64 && prefix_have[cat_i]) {
+        h = prefix_crc[cat_i];
+      } else {
+        char prefix[24];
+        int plen = 0;
+        char tmp[20];
+        int tl = 0;
+        long t = cat_i;
+        do {
+          tmp[tl++] = static_cast<char>('0' + (t % 10));
+          t /= 10;
+        } while (t);
+        while (tl) prefix[plen++] = tmp[--tl];
+        prefix[plen++] = '=';
+        h = crc32_zlib(prefix, plen, 0);
+        if (cat_i < 64) {
+          prefix_crc[cat_i] = h;
+          prefix_have[cat_i] = true;
+        }
+      }
+      h = crc32_zlib(vs, ve - vs, h);
+      ii[k] = static_cast<int32_t>(dense_budget + hash_mod.mod(h));
+      vv[k] = ((h >> 1) & 1u) == 0 ? 1.0f : -1.0f;
+      ++k;
+    }
+    any = true;  // presence (even past the max_nnz cap)
+    ++cat_i;
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ',') { ++c.p; skip_ws(c); continue; }
+    if (c.p < c.end && *c.p == ']') { ++c.p; return 0; }
+    return 1;
+  }
+  return 1;
+}
+
+// Numeric array items into COO slots (cursor just past '['): nonzero
+// values at positions < dense_budget take slots; the positional cursor
+// advances regardless. Returns 0 ok, 1 malformed. Shared by the general
+// walk and the schema template.
+inline int parse_num_items_coo(Cursor& c, int dense_budget, int max_nnz,
+                               int32_t* ii, float* vv, int& k, long& pos,
+                               bool& any) {
+  skip_ws(c);
+  if (c.p < c.end && *c.p == ']') { ++c.p; return 0; }
+  while (c.p < c.end) {
+    double v;
+    if (!parse_number(c, &v)) return 1;
+    any = true;  // validity = feature PRESENCE (is_valid counts the
+                 // raw lists), not whether a nonzero slot was stored
+    if (pos < dense_budget && v != 0.0 && k < max_nnz) {
+      ii[k] = static_cast<int32_t>(pos);
+      vv[k] = to_f32_clamped(v);
+      ++k;
+    }
+    if (pos < dense_budget) ++pos;
+    if (c.p >= c.end) return 1;
+    char ch = *c.p;
+    if (ch == ',') {
+      ++c.p;
+      if (c.p < c.end && *c.p == ' ') ++c.p;
+      skip_ws(c);
+      continue;
+    }
+    if (ch == ']') { ++c.p; return 0; }
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ',') { ++c.p; skip_ws(c); continue; }
+    if (c.p < c.end && *c.p == ']') { ++c.p; return 0; }
+    return 1;
+  }
+  return 1;
+}
+
 // Parse one line into padded-COO row i. Same valid semantics as
 // parse_one_line (0 drop, 1 keep, 2 Python fallback).
 inline void parse_one_line_sparse(const char* p, const char* line_end,
@@ -889,6 +983,59 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
       (ll == 5 && strncmp(q, "\"EOS\"", 5) == 0))
     return;
   if (*q != '{') return;
+
+  // Whole-line schema template: the dominant sparse record shape
+  // {"numericalFeatures": [..], "categoricalFeatures": [..],
+  //  "target": N, "operation": "training"} short-circuits the key walk
+  // (four key scans + member machinery) into four memcmps around the
+  // shared item loops. Any mismatch falls through to the general walk,
+  // which re-parses from scratch (ii/vv scribbles are only read when
+  // *validi == 1) — semantics identical, the template is only a faster
+  // route for lines json.loads would accept.
+  {
+    static const char kHead[] = "{\"numericalFeatures\": ";
+    static const char kCat[] = ", \"categoricalFeatures\": ";
+    static const char kTgt[] = ", \"target\": ";
+    static const char kOp[] = ", \"operation\": \"training\"}";
+    const long kHeadLen = sizeof(kHead) - 1;
+    const long kCatLen = sizeof(kCat) - 1;
+    const long kTgtLen = sizeof(kTgt) - 1;
+    const long kOpLen = sizeof(kOp) - 1;
+    if (ll > kHeadLen + kCatLen + kTgtLen + kOpLen &&
+        hash_space > 0 && hash_space <= 0xFFFFFFFFL &&
+        memcmp(q, kHead, kHeadLen) == 0 && q[kHeadLen] == '[') {
+      Cursor t{q + kHeadLen + 1, line_end};
+      int tk = 0;
+      long tpos = 0;
+      bool tany = false;
+      if (parse_num_items_coo(t, dense_budget, max_nnz, ii, vv, tk, tpos,
+                              tany) == 0 &&
+          line_end - t.p > kCatLen &&
+          memcmp(t.p, kCat, kCatLen) == 0 && t.p[kCatLen] == '[') {
+        t.p += kCatLen + 1;
+        int rc = parse_cat_items(t, dense_budget, hash_mod, max_nnz, ii,
+                                 vv, tk, tany);
+        if (rc == 2) { *validi = 2; return; }  // same verdict either route
+        if (rc == 0 && line_end - t.p >= kTgtLen &&
+            memcmp(t.p, kTgt, kTgtLen) == 0) {
+          t.p += kTgtLen;
+          double tv;
+          if (parse_number(t, &tv) && line_end - t.p >= kOpLen &&
+              memcmp(t.p, kOp, kOpLen) == 0) {
+            t.p += kOpLen;
+            while (t.p < line_end && is_edge_ws(*t.p)) ++t.p;
+            if (t.p == line_end) {
+              for (int z = tk; z < max_nnz; ++z) { ii[z] = 0; vv[z] = 0.0f; }
+              *yi = to_f32_clamped(tv);
+              *opi = 0;
+              *validi = tany ? 1 : 0;
+              return;
+            }
+          }
+        }
+      }
+    }
+  }
 
   Cursor c{q + 1, line_end};
   bool ok = true;
@@ -951,34 +1098,9 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
           break;
         }
         ++c.p;
-        skip_ws(c);
-        if (c.p < c.end && *c.p == ']') { ++c.p; break; }
-        while (c.p < c.end) {
-          double v;
-          if (!parse_number(c, &v)) { ok = false; break; }
-          any = true;  // validity = feature PRESENCE (is_valid counts the
-                       // raw lists), not whether a nonzero slot was stored
-          if (pos < dense_budget && v != 0.0 && k < max_nnz) {
-            ii[k] = static_cast<int32_t>(pos);
-            vv[k] = to_f32_clamped(v);
-            ++k;
-          }
-          if (pos < dense_budget) ++pos;
-          if (c.p >= c.end) { ok = false; break; }
-          char ch = *c.p;
-          if (ch == ',') {
-            ++c.p;
-            if (c.p < c.end && *c.p == ' ') ++c.p;
-            skip_ws(c);
-            continue;
-          }
-          if (ch == ']') { ++c.p; break; }
-          skip_ws(c);
-          if (c.p < c.end && *c.p == ',') { ++c.p; skip_ws(c); continue; }
-          if (c.p < c.end && *c.p == ']') { ++c.p; break; }
+        if (parse_num_items_coo(c, dense_budget, max_nnz, ii, vv, k, pos,
+                                any) != 0)
           ok = false;
-          break;
-        }
         break;
       }
       case KEY_CATEGORICAL: {
@@ -996,61 +1118,10 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
           break;
         }
         ++c.p;
-        skip_ws(c);
-        long cat_i = 0;
-        if (c.p < c.end && *c.p == ']') { ++c.p; break; }
-        while (c.p < c.end) {
-          if (*c.p != '"') { *validi = 2; return; }  // non-string element
-          const char* vs = c.p + 1;
-          const char* ve = scan_quote_or_special(vs, c.end);
-          if (ve >= c.end) { ok = false; break; }  // unterminated
-          if (*ve != '"') {
-            if (*ve == '\\') { *validi = 2; return; }  // Python decodes
-            ok = false;  // raw control char: json.loads drops the line
-            break;
-          }
-          c.p = ve + 1;
-          if (k < max_nnz) {
-            // CRC state after the "{i}=" prefix depends only on i: cache
-            // it (the prefixes repeat every line). snprintf here once
-            // measured ~5 us/line; the hand-rolled digits remain for the
-            // uncached tail (i >= 64)
-            uint32_t h;
-            static thread_local uint32_t prefix_crc[64];
-            static thread_local bool prefix_have[64];
-            if (cat_i < 64 && prefix_have[cat_i]) {
-              h = prefix_crc[cat_i];
-            } else {
-              char prefix[24];
-              int plen = 0;
-              char tmp[20];
-              int tl = 0;
-              long t = cat_i;
-              do {
-                tmp[tl++] = static_cast<char>('0' + (t % 10));
-                t /= 10;
-              } while (t);
-              while (tl) prefix[plen++] = tmp[--tl];
-              prefix[plen++] = '=';
-              h = crc32_zlib(prefix, plen, 0);
-              if (cat_i < 64) {
-                prefix_crc[cat_i] = h;
-                prefix_have[cat_i] = true;
-              }
-            }
-            h = crc32_zlib(vs, ve - vs, h);
-            ii[k] = static_cast<int32_t>(dense_budget + hash_mod.mod(h));
-            vv[k] = ((h >> 1) & 1u) == 0 ? 1.0f : -1.0f;
-            ++k;
-          }
-          any = true;  // presence (even past the max_nnz cap)
-          ++cat_i;
-          skip_ws(c);
-          if (c.p < c.end && *c.p == ',') { ++c.p; skip_ws(c); continue; }
-          if (c.p < c.end && *c.p == ']') { ++c.p; break; }
-          ok = false;
-          break;
-        }
+        int rc = parse_cat_items(c, dense_budget, hash_mod, max_nnz, ii,
+                                 vv, k, any);
+        if (rc == 2) { *validi = 2; return; }
+        if (rc != 0) ok = false;
         break;
       }
       case KEY_TARGET: {

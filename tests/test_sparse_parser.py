@@ -157,6 +157,75 @@ def test_sparse_fuzz_matches_python_codec(seed):
     np.testing.assert_array_equal(po, ro)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_sparse_template_mutations_match_python_codec(seed):
+    """The sparse whole-line schema template (fastparse.cpp) must agree
+    with the general walk AND the Python codec on near-misses of its
+    exact shape — every mutation must fall through with identical
+    keep/drop/fallback semantics."""
+    rng = np.random.RandomState(5000 + seed)
+    lines = []
+    for _ in range(200):
+        num = ", ".join("%.6f" % v for v in rng.randn(rng.randint(1, 6)))
+        cats = ", ".join(
+            '"%s"' % c for c in rng.choice(
+                ["red", "blue", "c%d" % rng.randint(99)],
+                size=rng.randint(1, 5),
+            )
+        )
+        line = (
+            '{"numericalFeatures": [%s], "categoricalFeatures": [%s], '
+            '"target": %.2f, "operation": "training"}'
+            % (num, cats, rng.rand())
+        )
+        r = rng.rand()
+        if r < 0.5:
+            lines.append(line)  # exact template shape
+        elif r < 0.7:  # single-byte mutation anywhere
+            i = rng.randint(len(line))
+            line = line[:i] + chr(rng.randint(32, 127)) + line[i + 1 :]
+            lines.append(line)
+        elif r < 0.8:  # truncation
+            lines.append(line[: rng.randint(1, len(line))])
+        elif r < 0.9:  # trailing junk / whitespace
+            lines.append(line + rng.choice([" ", "\t", " x", "\x0c", "}"]))
+        else:  # near-miss keys and operations
+            lines.append(
+                line.replace("training", rng.choice(
+                    ["Training", "training ", "train", "forecasting"]
+                ))
+            )
+    block = ("\n".join(lines) + "\n").encode()
+    pi, pv, py_, po = packed_rows(block)
+    ri, rv, ry, ro = reference_rows(block)
+    assert pi.shape == ri.shape
+    np.testing.assert_array_equal(pi, ri)
+    np.testing.assert_allclose(pv, rv, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(py_, ry, rtol=1e-6, atol=0)
+    np.testing.assert_array_equal(po, ro)
+
+
+def test_hash_space_beyond_uint32_defers_to_python():
+    """hash_space must fit uint32 for the C fastmod; larger spaces defer
+    every categorical line to the full-precision Python hasher (valid=2)
+    instead of crashing FastMod construction (divide-by-zero at exactly
+    2^32) or hashing modulo a truncated divisor."""
+    from omldm_tpu.ops.native import SparseFastParser
+
+    line = (
+        b'{"numericalFeatures": [1.5], "categoricalFeatures": ["red"], '
+        b'"target": 1.0, "operation": "training"}\n'
+    )
+    for space in (1 << 32, (1 << 32) + 7):
+        p = SparseFastParser(DENSE, space, K)
+        _, _, _, _, valid = p.parse(line)
+        assert valid[0] == 2, f"hash_space {space} should defer to Python"
+    # the boundary value itself stays in C
+    p = SparseFastParser(DENSE, 0xFFFFFFFF, K)
+    _, _, _, _, valid = p.parse(line)
+    assert valid[0] == 1
+
+
 def test_crc32_hash_parity_exact():
     """The C CRC32 must match zlib.crc32 bit-for-bit (bucket AND sign)."""
     import zlib
