@@ -822,7 +822,10 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
 
     def _host_pass():
         if use_fused:
-            job_h.run_file_fused(tmp.name)
+            # SERIAL fused ingest explicitly: t_host is defined as the
+            # single-thread parse ceiling (run_file_fused now auto-routes
+            # to the overlapped loop, which is measured separately below)
+            bridge_h.ingest_file(tmp.name)
         else:
             for batch in prefetch(
                 iter_file_batches(tmp.name, dim, 32768), depth=3
@@ -868,7 +871,7 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
 
     t0 = time.perf_counter()
     if use_fused and job.fused_file_bridge():
-        job.run_file_fused(tmp.name)
+        bridge.ingest_file(tmp.name)  # serial: raw vs raw_overlapped
     else:
         for batch in prefetch(iter_file_batches(tmp.name, dim, 32768), depth=3):
             job.process_packed_batch(*batch)

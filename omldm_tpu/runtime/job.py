@@ -468,11 +468,19 @@ class StreamJob:
     def run_file_fused(self, path: str) -> bool:
         """Consume a JSON-lines training file through the fused C ingest
         (SPMDBridge.ingest_file). Returns False when the job does not
-        qualify — callers fall back to the packed event route."""
+        qualify — callers fall back to the packed event route. Non-paced
+        pipelines take the DOUBLE-BUFFERED route (the parse thread fills
+        stage k+1 while the dispatch thread trains stage k; results are
+        bit-identical to the serial loop, tests/test_overlap.py)."""
         bridge = self.fused_file_bridge()
         if bridge is None:
             return False
-        bridge.ingest_file(path, on_chunk=self.stats.mark_activity)
+        if bridge.supports_overlapped_ingest():
+            bridge.ingest_file_overlapped(
+                path, on_chunk=self.stats.mark_activity
+            )
+        else:
+            bridge.ingest_file(path, on_chunk=self.stats.mark_activity)
         return True
 
     # --- run loops ---

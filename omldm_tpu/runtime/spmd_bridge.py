@@ -435,6 +435,23 @@ class SPMDBridge:
             if on_chunk is not None:
                 on_chunk()
 
+    def supports_overlapped_ingest(self) -> bool:
+        """Double-buffered ingest needs chained launches (not SSP's paced
+        per-launch accept flags) and the DENSE fused stage — the sparse
+        bridge's COO ingest overrides this off. It holds ``depth`` extra
+        stage buffer pairs (default 2: ~3x staging memory); set
+        trainingConfiguration extra ``{"overlappedIngest": false}`` to
+        keep the serial fused route on memory-tight hosts."""
+        flag = str(
+            self.request.training_configuration.extra.get(
+                "overlappedIngest", "true"
+            )
+        ).lower()
+        return (
+            self.supports_fused_ingest() and not self._paced
+            and flag != "false"
+        )
+
     def ingest_file_overlapped(
         self, path: str, chunk_bytes: int = 1 << 22, on_chunk=None,
         depth: int = 2, train_fn=None,
@@ -739,6 +756,12 @@ class SparseSPMDBridge(SPMDBridge):
         from omldm_tpu.ops.native import fast_parser_available
 
         return fast_parser_available()
+
+    def supports_overlapped_ingest(self) -> bool:
+        """The base class's double-buffered loop drives the DENSE fused
+        stage; the COO route stays serial (its device scatter dominates
+        and the C parse already overlaps via async dispatch)."""
+        return False
 
     # --- data path ---
 
