@@ -90,6 +90,71 @@ def _line_aligned_chunks(path: str, chunk_bytes: int):
             yield buf, carry + 1
 
 
+class _OverlapDispatcher:
+    """Bounded producer/consumer scaffolding shared by the dense and
+    sparse double-buffered ingest routes: a pool of ``depth`` spare stage
+    sets bounds look-ahead memory (the parse thread blocks on ``swap``
+    when the device is behind), a work queue dispatches sets strictly in
+    order on one daemon thread, and worker exceptions surface to the
+    parse thread — the set returns to the pool even when the launch
+    raises, so the producer can never deadlock in ``swap`` instead of
+    seeing the error."""
+
+    def __init__(self, make_set, depth: int, train):
+        import queue
+        import threading
+
+        self.pool: "queue.Queue" = queue.Queue()
+        for _ in range(max(depth, 1)):
+            self.pool.put(make_set())
+        self.work: "queue.Queue" = queue.Queue()
+        self.errors: List[BaseException] = []
+        self._train = train
+
+        def worker():
+            while True:
+                item = self.work.get()
+                try:
+                    if item is None:
+                        return
+                    stage_set, n = item
+                    if not self.errors:
+                        self._train(stage_set, n)
+                except BaseException as exc:  # surfaced to the producer
+                    self.errors.append(exc)
+                finally:
+                    if item is not None:
+                        self.pool.put(item[0])
+                    self.work.task_done()
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def submit(self, stage_set, n: int):
+        """Queue a filled set, return a fresh one from the pool. Raises
+        any pending worker error instead of queueing more work onto a
+        dead pipeline."""
+        if self.errors:
+            raise self.errors[0]
+        self.work.put((stage_set, n))
+        return self.pool.get()
+
+    def quiesce(self) -> None:
+        """Drain the queue (producer-side trainer access needs the worker
+        idle); re-raise any worker error."""
+        self.work.join()
+        if self.errors:
+            raise self.errors[0]
+
+    def close(self) -> None:
+        self.work.put(None)
+        self._thread.join()
+
+    def raise_pending(self) -> None:
+        if self.errors:
+            raise self.errors[0]
+
+
 class SPMDBridge:
     """One pipeline, streaming in, trained across the device mesh."""
 
@@ -277,20 +342,22 @@ class SPMDBridge:
         rows."""
         if n == 0:
             return
+        # COPY before handing rows to the device: dispatch is async and
+        # jax may alias numpy argument buffers zero-copy (observed on the
+        # CPU backend — reusing the stage buffer mid-read corrupted rows
+        # nondeterministically), and under SSP refused batches re-enter
+        # the reused stage anyway. The memcpy is small next to the parse.
         b = self.config.batch_size
         group = self.dp * b
         if full and not self._paced:
-            xs = buf_x.reshape(self.chain, self.dp, b, self.dim)
-            ys = buf_y.reshape(self.chain, self.dp, b)
+            xs = np.array(buf_x, copy=True).reshape(
+                self.chain, self.dp, b, self.dim
+            )
+            ys = np.array(buf_y, copy=True).reshape(self.chain, self.dp, b)
             self.trainer.step_many_dense(xs, ys)
             return
-        if self._paced:
-            # copy: refused batches re-enter the (reused) stage buffer
-            stage_x = buf_x[:n].copy()
-            stage_y = buf_y[:n].copy()
-        else:
-            stage_x = buf_x[:n]
-            stage_y = buf_y[:n]
+        stage_x = buf_x[:n].copy()
+        stage_y = buf_y[:n].copy()
         done = 0
         while n - done >= group:
             xg = stage_x[done : done + group].reshape(self.dp, b, self.dim)
@@ -437,9 +504,9 @@ class SPMDBridge:
 
     def supports_overlapped_ingest(self) -> bool:
         """Double-buffered ingest needs chained launches (not SSP's paced
-        per-launch accept flags) and the DENSE fused stage — the sparse
-        bridge's COO ingest overrides this off. It holds ``depth`` extra
-        stage buffer pairs (default 2: ~3x staging memory); set
+        per-launch accept flags); both the dense fused stage and the
+        sparse COO route implement it. It holds ``depth`` extra stage
+        buffer sets (default 2: ~3x staging memory); set
         trainingConfiguration extra ``{"overlappedIngest": false}`` to
         keep the serial fused route on memory-tight hosts."""
         flag = str(
@@ -475,9 +542,6 @@ class SPMDBridge:
         Job.scala:42-70 -> FlinkSpoke.scala:92-107 (Flink's operator
         chain keeps source/parse and the learner's fit concurrent across
         its task threads; this is the TPU-native two-thread form)."""
-        import queue
-        import threading
-
         if self._paced:
             raise ValueError(
                 "overlapped ingest requires chained launches; SSP's "
@@ -499,61 +563,29 @@ class SPMDBridge:
             )
             return (sx, sy, fs)
 
-        current = (self._stage_x, self._stage_y, self._fused_stage())
-        free: "queue.Queue" = queue.Queue()
-        for _ in range(max(depth, 1)):
-            free.put(make_pair())
-        work: "queue.Queue" = queue.Queue()
-        errors: List[BaseException] = []
         train = train_fn or (
             lambda sx, sy, n: self._train_buffer(
                 sx, sy, n, full=(n == self._stage_cap)
             )
         )
-
-        def worker():
-            while True:
-                item = work.get()
-                try:
-                    if item is None:
-                        return
-                    pair, n = item
-                    if not errors:
-                        train(pair[0], pair[1], n)
-                except BaseException as exc:  # surfaced to the parse thread
-                    errors.append(exc)
-                finally:
-                    # the pair returns to the pool even when train raised —
-                    # a lost pair would leave the parse thread blocked in
-                    # free.get() forever instead of seeing the error
-                    if item is not None:
-                        free.put(item[0])
-                    work.task_done()
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
+        disp = _OverlapDispatcher(
+            make_pair, depth, lambda s, n: train(s[0], s[1], n)
+        )
+        current = (self._stage_x, self._stage_y, self._fused_stage())
 
         def on_stage_full():
             nonlocal current
-            if errors:
-                raise errors[0]
-            work.put((current, self._stage_cap))
-            current = free.get()
+            current = disp.submit(current, self._stage_cap)
             self._stage_x, self._stage_y = current[0], current[1]
             self._fused = current[2]
             self._stage_n = 0
             return current[2]
 
-        def quiesce():
-            work.join()
-            if errors:
-                raise errors[0]
-
         try:
             for buf, stop in _line_aligned_chunks(path, chunk_bytes):
                 self._fused_consume(
                     current[2], buf, 0, stop,
-                    on_stage_full=on_stage_full, quiesce=quiesce,
+                    on_stage_full=on_stage_full, quiesce=disp.quiesce,
                 )
                 if on_chunk is not None:
                     on_chunk()
@@ -561,12 +593,10 @@ class SPMDBridge:
             n_tail = self._stage_n
             self._stage_n = 0
             if n_tail:
-                work.put((current, n_tail))
+                disp.submit(current, n_tail)
         finally:
-            work.put(None)
-            t.join()
-        if errors:
-            raise errors[0]
+            disp.close()
+        disp.raise_pending()
 
     def _fused_consume(
         self, fs, buf: bytearray, start: int, stop: int,
@@ -757,11 +787,76 @@ class SparseSPMDBridge(SPMDBridge):
 
         return fast_parser_available()
 
-    def supports_overlapped_ingest(self) -> bool:
-        """The base class's double-buffered loop drives the DENSE fused
-        stage; the COO route stays serial (its device scatter dominates
-        and the C parse already overlaps via async dispatch)."""
-        return False
+    # supports_overlapped_ingest: inherited — supports_fused_ingest is
+    # polymorphic and the opt-out knob is shared with the dense route.
+
+    def _make_coo_parser(self):
+        from omldm_tpu.ops.native import SparseFastParser
+
+        return SparseFastParser(
+            self.vectorizer.dim - self.vectorizer.hash_space,
+            self.vectorizer.hash_space,
+            self.max_nnz,
+        )
+
+    def ingest_file_overlapped(
+        self, path: str, chunk_bytes: int = 1 << 22, on_chunk=None,
+        depth: int = 2, train_fn=None,
+    ) -> None:
+        """DOUBLE-BUFFERED COO ingest: the C padded-COO parse + holdout
+        split + staging fill stage set k+1 while the dispatch thread runs
+        stage k's collective steps — the sparse e2e path is host-parse
+        bound and the device scatter costs about as much, so overlapping
+        them approaches max() instead of their sum. Stage sets dispatch
+        strictly in order: results are bit-identical to the serial
+        :meth:`ingest_file` (pinned by tests/test_overlap.py). Specials
+        (forecasts, codec fallbacks) quiesce the queue first, exactly
+        like the dense route."""
+        import queue
+        import threading
+
+        if self._paced:
+            raise ValueError(
+                "overlapped ingest requires chained launches; SSP's "
+                "per-launch accept flags force the serial path"
+            )
+        parser = self._make_coo_parser()
+
+        def make_set():
+            return (
+                np.zeros_like(self._stage_i),
+                np.zeros_like(self._stage_v),
+                np.zeros_like(self._stage_y),
+            )
+
+        train = train_fn or (
+            lambda si, sv, sy, n: self._launch_coo(si, sv, sy, n)
+        )
+        disp = _OverlapDispatcher(
+            make_set, depth, lambda s, n: train(s[0], s[1], s[2], n)
+        )
+        self._coo_enqueue = disp
+        self._coo_quiesce = disp.quiesce
+        try:
+            for buf, stop in _line_aligned_chunks(path, chunk_bytes):
+                disp.raise_pending()
+                self._consume_coo_block(
+                    parser, bytes(memoryview(buf)[:stop])
+                )
+                if on_chunk is not None:
+                    on_chunk()
+            # final partial stage drains through the same ordered queue
+            n_tail = self._stage_n
+            self._stage_n = 0
+            if n_tail:
+                (self._stage_i, self._stage_v, self._stage_y) = disp.submit(
+                    (self._stage_i, self._stage_v, self._stage_y), n_tail
+                )
+        finally:
+            self._coo_enqueue = None
+            self._coo_quiesce = None
+            disp.close()
+        disp.raise_pending()
 
     # --- data path ---
 
@@ -869,17 +964,33 @@ class SparseSPMDBridge(SPMDBridge):
         n = self._stage_n
         if n == 0:
             return
-        b = self.config.batch_size
-        if self._paced:
-            # copy: refused batches re-enter the (reused) stage buffers
-            si = self._stage_i[:n].copy()
-            sv = self._stage_v[:n].copy()
-            sy = self._stage_y[:n].copy()
-        else:
-            si, sv, sy = (
-                self._stage_i[:n], self._stage_v[:n], self._stage_y[:n]
+        # double-buffered ingest: hand the filled stage set to the
+        # dispatch thread and continue parsing into a fresh set from the
+        # pool (the serial path launches inline below)
+        if getattr(self, "_coo_enqueue", None) is not None:
+            (self._stage_i, self._stage_v, self._stage_y) = (
+                self._coo_enqueue.submit(
+                    (self._stage_i, self._stage_v, self._stage_y), n
+                )
             )
+            self._stage_n = 0
+            return
         self._stage_n = 0
+        self._launch_coo(
+            self._stage_i, self._stage_v, self._stage_y, n
+        )
+
+    def _launch_coo(self, si, sv, sy, n) -> None:
+        """Launch ``n`` staged COO rows (explicit arrays, so the
+        double-buffered dispatch thread can drive it on pooled sets).
+        Rows are COPIED before device handoff: dispatch is async and jax
+        may alias numpy argument buffers zero-copy (observed on CPU),
+        while both the serial stage and the pooled sets are reused as
+        soon as this returns; SSP requeue also re-enters these buffers."""
+        si = si[:n].copy()
+        sv = sv[:n].copy()
+        sy = sy[:n].copy()
+        b = self.config.batch_size
         group = self.dp * b
         done = 0
         while n - done >= group:
@@ -996,13 +1107,7 @@ class SparseSPMDBridge(SPMDBridge):
         categorical hashing in C, parity fuzz-pinned by
         tests/test_sparse_parser.py); fallback lines, forecasts and drops
         re-route through the per-record codec at their stream position."""
-        from omldm_tpu.ops.native import SparseFastParser
-
-        parser = SparseFastParser(
-            self.vectorizer.dim - self.vectorizer.hash_space,
-            self.vectorizer.hash_space,
-            self.max_nnz,
-        )
+        parser = self._make_coo_parser()
         for buf, stop in _line_aligned_chunks(path, chunk_bytes):
             # one copy (memoryview slice): the special-line handling needs
             # real bytes for lazy line splitting anyway
@@ -1030,6 +1135,13 @@ class SparseSPMDBridge(SPMDBridge):
                 lines[s].decode("utf-8", errors="replace")
             )
             if inst is not None:
+                if getattr(self, "_coo_quiesce", None) is not None:
+                    # specials may touch the trainer from this (producer)
+                    # thread (forecasts serve a prediction): drain queued
+                    # collective steps first — including any enqueued by
+                    # the staging right above — so two threads never race
+                    # on trainer state
+                    self._coo_quiesce()
                 self.handle_data(inst)
             prev = s + 1
         if prev < n:

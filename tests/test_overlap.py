@@ -173,6 +173,73 @@ class TestOverlappedIngest:
                 str(path), chunk_bytes=4096, train_fn=boom
             )
 
+    def test_sparse_overlapped_matches_serial(self, tmp_path):
+        """The sparse (padded-COO) double-buffered ingest dispatches stage
+        sets strictly in order: identical trained params, fitted count,
+        holdout and predictions to the serial COO route — including
+        mid-stream forecasts (which quiesce the dispatch queue) and
+        escape-bearing fallback lines."""
+        import json as _json
+
+        rng = np.random.RandomState(3)
+        path = tmp_path / "sparse.jsonl"
+        with open(path, "w") as f:
+            for i in range(4000):
+                nums = [round(float(v), 6) for v in rng.randn(5)]
+                cats = [f"c{j}_{rng.randint(50)}" for j in range(6)]
+                if i % 701 == 200:
+                    f.write(_json.dumps({
+                        "numericalFeatures": nums,
+                        "categoricalFeatures": cats,
+                        "operation": "forecasting",
+                    }) + "\n")
+                    continue
+                if i % 997 == 500:  # escaped category -> Python fallback
+                    cats[0] = 'a"b'
+                f.write(_json.dumps({
+                    "numericalFeatures": nums,
+                    "categoricalFeatures": cats,
+                    "target": float(rng.randint(2)),
+                    "operation": "training",
+                }) + "\n")
+
+        def make_sparse_bridge():
+            preds = []
+            config = JobConfig(
+                parallelism=2, batch_size=32, test=True, test_set_size=32
+            )
+            job = StreamJob(config)
+            job.set_sinks(on_prediction=preds.append)
+            job.process_event(REQUEST_STREAM, json.dumps({
+                "id": 0, "request": "Create",
+                "learner": {
+                    "name": "PA", "hyperParameters": {"C": 0.5},
+                    "dataStructure": {
+                        "sparse": True, "nFeatures": 5 + 512,
+                        "hashSpace": 512, "maxNnz": 12,
+                    },
+                },
+                "trainingConfiguration": {
+                    "protocol": "Synchronous", "engine": "spmd",
+                    "extra": {"stageChain": 2},
+                },
+            }))
+            [bridge] = job.spmd_bridges.values()
+            return bridge, preds
+
+        serial, s_preds = make_sparse_bridge()
+        serial.ingest_file(str(path))
+        serial.flush()
+        over, o_preds = make_sparse_bridge()
+        over.ingest_file_overlapped(str(path), depth=2)
+        over.flush()
+        assert over.trainer.fitted == serial.trainer.fitted > 0
+        assert len(over.test_set) == len(serial.test_set)
+        np.testing.assert_array_equal(_flat(over), _flat(serial))
+        assert len(o_preds) == len(s_preds) > 0
+        for a, b in zip(o_preds, s_preds):
+            assert a.value == b.value
+
     def test_ssp_rejected(self, tmp_path):
         preds = []
         config = JobConfig(
