@@ -350,6 +350,38 @@ class TestDistributedStreamJob:
         assert s["fitted"] + report["holdout"]["0"] == 2000
         assert s["score"] > 0.8
 
+    def test_kafka_three_processes_two_topics(self, tmp_path):
+        """Uneven partition counts across topics and processes: 5 train
+        partitions + a single-partition forecast topic over 3 processes.
+        The rotating stripe base must spread single-partition topics off
+        process 0, and every partition of BOTH topics must be consumed
+        (row conservation + forecasts served)."""
+        sys.path.insert(0, TESTS)
+        import fskafka
+
+        broker = tmp_path / "broker"
+        os.environ["FSKAFKA_DIR"] = str(broker)
+        try:
+            lines, _ = _rows(1500, 12, seed=5)
+            for i, line in enumerate(lines):
+                fskafka.append("trainingData", line, partition=i % 5)
+            fore, n_fore = _rows(60, 12, seed=6, forecast_every=1)
+            for line in fore:
+                fskafka.append("forecastingData", line, partition=0)
+            fskafka.append("requests", _create())
+        finally:
+            os.environ.pop("FSKAFKA_DIR", None)
+        assert n_fore == 60
+        report, preds, _ = _launch(
+            tmp_path, 3, ["--kafkaBrokers", "fs://local"],
+            "kafka3", boot=FSKAFKA_BOOT,
+            env_extra={"FSKAFKA_DIR": str(broker)},
+        )
+        s = _stat(report, 0)
+        assert s["fitted"] + report["holdout"]["0"] == 1500
+        assert len(preds) == 60
+        assert all(np.isfinite(p["value"]) for p in preds)
+
     def test_kafka_offset_resume(self, tmp_path):
         """Crash mid-consumption with per-partition offsets checkpointed;
         the resumed deployment seeks each assigned partition back to its
