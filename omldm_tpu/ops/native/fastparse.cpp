@@ -1178,6 +1178,60 @@ inline void parse_one_line_sparse(const char* p, const char* line_end,
   *validi = any ? 1 : 0;
 }
 
+// Shared multithreaded line driver: index newline offsets, then run
+// ``per_line(i, line, line_end)`` over disjoint line ranges on
+// std::threads (each line owns its output row; nothing is shared).
+// Returns lines consumed; stores the consumed byte offset.
+template <typename F>
+int mt_line_driver(const char* buf, long len, int max_records,
+                   int n_threads, long* bytes_consumed, F per_line) {
+  std::vector<long> starts;
+  starts.reserve(4096);
+  const char* p = buf;
+  const char* bufend = buf + len;
+  while (p < bufend && static_cast<int>(starts.size()) < max_records) {
+    starts.push_back(p - buf);
+    const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
+    p = nl ? nl + 1 : bufend;
+  }
+  const long consumed = p - buf;
+  if (bytes_consumed) *bytes_consumed = consumed;
+  int n = static_cast<int>(starts.size());
+  if (n == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n) n_threads = n;
+
+  auto worker = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const char* line = buf + starts[i];
+      // starts[i+1]-1 lands on the '\n'; the final indexed line ends at
+      // the consumed offset (== len unless max_records truncated)
+      long line_len =
+          ((i + 1 < n) ? starts[i + 1] - 1 : consumed) - starts[i];
+      if (line_len < 0) line_len = 0;
+      const char* line_end = line + line_len;
+      if (line_end > bufend) line_end = bufend;
+      if (line_end > line && line_end[-1] == '\n') --line_end;
+      per_line(i, line, line_end);
+    }
+  };
+  if (n_threads == 1) {
+    worker(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    int chunk = (n + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int lo = t * chunk;
+      int hi = lo + chunk < n ? lo + chunk : n;
+      if (lo >= hi) break;
+      threads.emplace_back(worker, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return n;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1349,52 +1403,32 @@ int omldm_parse_lines_mt(const char* buf, long len, int dim, int max_records,
                          float* x, float* y, unsigned char* op,
                          unsigned char* valid, int n_threads,
                          long* bytes_consumed) {
-  // index line starts (single memchr sweep; never the bottleneck)
-  std::vector<long> starts;
-  starts.reserve(4096);
-  const char* p = buf;
-  const char* bufend = buf + len;
-  while (p < bufend && static_cast<int>(starts.size()) < max_records) {
-    starts.push_back(p - buf);
-    const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
-    p = nl ? nl + 1 : bufend;
-  }
-  const long consumed = p - buf;
-  if (bytes_consumed) *bytes_consumed = consumed;
-  int n = static_cast<int>(starts.size());
-  if (n == 0) return 0;
-  if (n_threads < 1) n_threads = 1;
-  if (n_threads > n) n_threads = n;
+  return mt_line_driver(
+      buf, len, max_records, n_threads, bytes_consumed,
+      [&](int i, const char* line, const char* line_end) {
+        parse_one_line(line, line_end, dim, x + static_cast<long>(i) * dim,
+                       y + i, op + i, valid + i);
+      });
+}
 
-  auto worker = [&](int lo, int hi) {
-    for (int i = lo; i < hi; ++i) {
-      const char* line = buf + starts[i];
-      // starts[i+1]-1 lands on the '\n'; the final indexed line ends at the
-      // consumed offset (== len unless max_records truncated the sweep)
-      long line_len = ((i + 1 < n) ? starts[i + 1] - 1 : consumed) - starts[i];
-      if (line_len < 0) line_len = 0;
-      const char* line_end = line + line_len;
-      if (line_end > bufend) line_end = bufend;
-      if (line_end > line && line_end[-1] == '\n') --line_end;
-      parse_one_line(line, line_end, dim, x + static_cast<long>(i) * dim,
-                     y + i, op + i, valid + i);
-    }
-  };
-  if (n_threads == 1) {
-    worker(0, n);
-  } else {
-    std::vector<std::thread> threads;
-    threads.reserve(n_threads);
-    int chunk = (n + n_threads - 1) / n_threads;
-    for (int t = 0; t < n_threads; ++t) {
-      int lo = t * chunk;
-      int hi = lo + chunk < n ? lo + chunk : n;
-      if (lo >= hi) break;
-      threads.emplace_back(worker, lo, hi);
-    }
-    for (auto& th : threads) th.join();
-  }
-  return n;
+int omldm_parse_lines_sparse_mt(const char* buf, long len, int dense_budget,
+                                long hash_space, int max_nnz,
+                                int max_records, int32_t* idx, float* val,
+                                float* y, unsigned char* op,
+                                unsigned char* valid, int n_threads,
+                                long* bytes_consumed) {
+  const bool hash_fits = hash_space > 0 && hash_space <= 0xFFFFFFFFL;
+  const FastMod hash_mod(
+      hash_fits ? static_cast<uint32_t>(hash_space) : 1u);
+  return mt_line_driver(
+      buf, len, max_records, n_threads, bytes_consumed,
+      [&](int i, const char* line, const char* line_end) {
+        parse_one_line_sparse(line, line_end, dense_budget, hash_space,
+                              hash_mod, max_nnz,
+                              idx + static_cast<long>(i) * max_nnz,
+                              val + static_cast<long>(i) * max_nnz, y + i,
+                              op + i, valid + i);
+      });
 }
 
 }  // extern "C"

@@ -82,13 +82,17 @@ def _build() -> Optional[ctypes.CDLL]:
     ll_p = ctypes.POINTER(ctypes.c_longlong)
     f_p = ctypes.POINTER(ctypes.c_float)
     i32_p = ctypes.POINTER(ctypes.c_int32)
-    lib.omldm_parse_lines_sparse.restype = ctypes.c_int
-    lib.omldm_parse_lines_sparse.argtypes = [
+    sparse_argtypes = [
         ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_long,
         ctypes.c_int, ctypes.c_int, i32_p,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_ubyte),
-        consumed_p,
+    ]
+    lib.omldm_parse_lines_sparse.restype = ctypes.c_int
+    lib.omldm_parse_lines_sparse.argtypes = sparse_argtypes + [consumed_p]
+    lib.omldm_parse_lines_sparse_mt.restype = ctypes.c_int
+    lib.omldm_parse_lines_sparse_mt.argtypes = sparse_argtypes + [
+        ctypes.c_int, consumed_p,
     ]
     lib.omldm_parse_stage.restype = ctypes.c_int
     lib.omldm_parse_stage.argtypes = [
@@ -141,10 +145,19 @@ class SparseFastParser:
     ``[dense_budget, dense_budget + hash_space)`` with the signed rule —
     bit-identical to SparseVectorizer.vectorize (fuzz-pinned)."""
 
-    def __init__(self, dense_budget: int, hash_space: int, max_nnz: int):
+    def __init__(self, dense_budget: int, hash_space: int, max_nnz: int,
+                 n_threads: int = 0):
         self.dense_budget = dense_budget
         self.hash_space = hash_space
         self.max_nnz = max_nnz
+        # <= 0 = auto (FastParser's rule: min(cores, 8)); > 1 parses
+        # disjoint line ranges on C threads (each line owns its output
+        # row; the CRC prefix cache is thread_local) — the sparse e2e
+        # path is parse-bound, so multi-core hosts scale it with the same
+        # _mt scheme as the dense parser
+        if n_threads <= 0:
+            n_threads = min(os.cpu_count() or 1, 8)
+        self.n_threads = int(n_threads)
         lib = _get_lib()
         if lib is None:
             raise RuntimeError("native fast parser unavailable (g++ build failed)")
@@ -158,7 +171,7 @@ class SparseFastParser:
         op = np.empty((n_cap,), np.uint8)
         valid = np.empty((n_cap,), np.uint8)
         done = ctypes.c_long(0)
-        n = self._lib.omldm_parse_lines_sparse(
+        common = (
             ctypes.c_void_p(addr), length, self.dense_budget,
             self.hash_space, k, n_cap,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -166,8 +179,15 @@ class SparseFastParser:
             y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             op.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
             valid.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
-            ctypes.byref(done),
         )
+        if self.n_threads > 1:
+            n = self._lib.omldm_parse_lines_sparse_mt(
+                *common, self.n_threads, ctypes.byref(done)
+            )
+        else:
+            n = self._lib.omldm_parse_lines_sparse(
+                *common, ctypes.byref(done)
+            )
         return idx[:n], val[:n], y[:n], op[:n], valid[:n], done.value
 
     def parse(self, data: bytes):

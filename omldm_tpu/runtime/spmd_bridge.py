@@ -793,10 +793,17 @@ class SparseSPMDBridge(SPMDBridge):
     def _make_coo_parser(self):
         from omldm_tpu.ops.native import SparseFastParser
 
+        # parserThreads: 0 = auto (min(cores, 8), FastParser's rule) —
+        # multi-core hosts parse disjoint line ranges on C threads
         return SparseFastParser(
             self.vectorizer.dim - self.vectorizer.hash_space,
             self.vectorizer.hash_space,
             self.max_nnz,
+            n_threads=int(
+                self.request.training_configuration.extra.get(
+                    "parserThreads", 0
+                )
+            ),
         )
 
     def ingest_file_overlapped(

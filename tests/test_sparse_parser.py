@@ -205,6 +205,28 @@ def test_sparse_template_mutations_match_python_codec(seed):
     np.testing.assert_array_equal(po, ro)
 
 
+def test_multithreaded_sparse_parse_matches_single():
+    """omldm_parse_lines_sparse_mt must produce IDENTICAL outputs to the
+    single-thread entry (disjoint line ranges, thread_local CRC caches) —
+    including fallback/drop/forecast rows and uneven range splits."""
+    from omldm_tpu.ops.native import SparseFastParser
+
+    rng = np.random.RandomState(77)
+    block = ("\n".join(make_lines(rng, 503)) + "\n").encode()
+    si, sv, sy, so, svd = SparseFastParser(DENSE, HASH, K).parse(block)
+    keep = svd == 1  # dropped/fallback rows leave idx/val unspecified
+    assert keep.sum() > 100
+    for nt in (2, 3, 7):
+        mi, mv, my, mo, mvd = SparseFastParser(
+            DENSE, HASH, K, n_threads=nt
+        ).parse(block)
+        np.testing.assert_array_equal(mvd, svd)
+        np.testing.assert_array_equal(mo, so)
+        np.testing.assert_array_equal(my[keep], sy[keep])
+        np.testing.assert_array_equal(mi[keep], si[keep])
+        np.testing.assert_array_equal(mv[keep], sv[keep])
+
+
 def test_hash_space_beyond_uint32_defers_to_python():
     """hash_space must fit uint32 for the C fastmod; larger spaces defer
     every categorical line to the full-precision Python hasher (valid=2)
