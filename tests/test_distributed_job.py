@@ -230,6 +230,40 @@ class TestDistributedStreamJob:
         s = _stat(report, 0)
         assert s["fitted"] + report["holdout"]["0"] == 1500 - n_fore
 
+    def test_nn_preprocessor_gm_two_processes(self, tmp_path):
+        """A deeper pipeline in the cluster shape: NN learner with a
+        StandardScaler preprocessor under the GM (violation-gated)
+        protocol — the collective eval/predict programs must thread the
+        preprocessor state, and the drift-gated sync must fire across
+        processes."""
+        train = tmp_path / "train.jsonl"
+        reqs = tmp_path / "reqs.jsonl"
+        n_fore = _write_stream(str(train), n=2000, forecast_every=200)
+        reqs.write_text(json.dumps({
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "NN",
+                "hyperParameters": {"learningRate": 5e-3},
+                "dataStructure": {"nFeatures": 12, "hiddenLayers": [16]},
+            },
+            "preProcessors": [{"name": "StandardScaler"}],
+            "trainingConfiguration": {
+                "protocol": "GM", "syncEvery": 1, "threshold": 0.05,
+            },
+        }) + "\n")
+        report, preds, _ = _launch(
+            tmp_path, 2,
+            ["--requests", str(reqs), "--trainingData", str(train)],
+            "nn_gm",
+        )
+        s = _stat(report, 0)
+        assert s["protocol"] == "GM"
+        assert s["fitted"] + report["holdout"]["0"] == 2000 - n_fore
+        assert len(preds) == n_fore
+        assert all(np.isfinite(p["value"]) for p in preds)
+        assert np.isfinite(s["score"])
+
     def test_multi_pipeline_query_delete(self, tmp_path):
         """The cluster deployment hosts the FULL control plane: two
         concurrent pipelines (SpokeLogic.scala:28-29), invalid requests
