@@ -1516,27 +1516,64 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
 
     # outputs: predictions per process (suffixed — a shared path would be
     # clobbered by the last writer and lose the other partitions' rows),
-    # responses + performance from process 0
-    if flags.get("predictionsOut"):
-        path = flags["predictionsOut"]
-        if job.nproc > 1:
-            path = f"{path}.p{job.pid}"
-        with open(path, "w") as f:
-            for net_id, v in job.orphan_predictions:
-                f.write(json.dumps({"mlpId": net_id, "value": v}) + "\n")
-            for net_id in sorted(job.pipelines):
-                for v in job.pipelines[net_id].predictions:
-                    f.write(json.dumps({"mlpId": net_id, "value": v}) + "\n")
+    # responses + performance from process 0. In Kafka mode, outputs
+    # WITHOUT an explicit file sink publish to the reference's output
+    # topics (predictions / responses / performance — README.md:21-26,
+    # FlinkLearning.scala:137-144) through the shared ProducerSinks; an
+    # explicitly-passed file sink keeps precedence over the producer,
+    # exactly the single-process CLI's rule (__main__._apply_kafka_sinks).
+    sinks = None
+    if flags.get("kafkaBrokers"):
+        try:
+            from kafka import KafkaProducer
+
+            from omldm_tpu.runtime.kafka_io import ProducerSinks
+
+            sinks = ProducerSinks(
+                KafkaProducer(bootstrap_servers=flags["kafkaBrokers"])
+            )
+        except Exception as exc:
+            # broker gone at shutdown must not lose the file outputs
+            job._warn(f"output-topic producer unavailable: {exc}")
+            sinks = None
+    want_preds_file = bool(flags.get("predictionsOut"))
+    publish_preds = sinks is not None and not want_preds_file
+    if want_preds_file or publish_preds:
+        payloads = [
+            {"mlpId": net_id, "value": v}
+            for net_id, v in job.orphan_predictions
+        ] + [
+            {"mlpId": net_id, "value": v}
+            for net_id in sorted(job.pipelines)
+            for v in job.pipelines[net_id].predictions
+        ]
+        if want_preds_file:
+            path = flags["predictionsOut"]
+            if job.nproc > 1:
+                path = f"{path}.p{job.pid}"
+            with open(path, "w") as f:
+                for obj in payloads:
+                    f.write(json.dumps(obj) + "\n")
+        else:
+            for obj in payloads:
+                sinks.on_prediction(obj)
     report = job.merged_report()
     if report is not None:
         if flags.get("responsesOut"):
             with open(flags["responsesOut"], "w") as f:
                 for resp in job.responses:
                     f.write(resp.to_json() + "\n")
+        elif sinks is not None:
+            for resp in job.responses:
+                sinks.on_response(resp)
         if flags.get("performanceOut"):
             with open(flags["performanceOut"], "w") as f:
                 f.write(json.dumps(report) + "\n")
+        elif sinks is not None:
+            sinks.on_performance(report)
         print(json.dumps(report))
+    if sinks is not None:
+        sinks.close()
     return 0
 
 

@@ -92,9 +92,11 @@ def _stat(report, net_id):
 
 
 def _launch(tmp_path, nproc, extra_flags, tag, boot=None, env_extra=None,
-            expect_rc=0, timeout=420):
+            expect_rc=0, timeout=420, file_sinks=True):
     """Run nproc processes of the distributed job; returns
-    (report or None, predictions, joined stderr)."""
+    (report or None, predictions, joined stderr). ``file_sinks=False``
+    omits the file outputs so Kafka-mode runs exercise the output-topic
+    route (file sinks take precedence over the producer)."""
     port = _free_port()
     perf = tmp_path / f"perf_{tag}.jsonl"
     preds = tmp_path / f"preds_{tag}.jsonl"
@@ -109,9 +111,11 @@ def _launch(tmp_path, nproc, extra_flags, tag, boot=None, env_extra=None,
             if boot
             else [sys.executable, "-m", "omldm_tpu.runtime.distributed_job"]
         )
-        args = head + [
-            "--performanceOut", str(perf),
-            "--predictionsOut", str(preds),
+        sink_flags = (
+            ["--performanceOut", str(perf), "--predictionsOut", str(preds)]
+            if file_sinks else []
+        )
+        args = head + sink_flags + [
             "--batchSize", "64",
             "--testSetSize", "32",
         ] + extra_flags
@@ -375,11 +379,16 @@ class TestDistributedStreamJob:
             fskafka.append("requests", _create())
         finally:
             os.environ.pop("FSKAFKA_DIR", None)
-        report, _, err = _launch(
+        # NO file sinks: the outputs must ride the reference's output
+        # topics (README.md:21-26; file sinks would take precedence)
+        _, _, err = _launch(
             tmp_path, 2, ["--kafkaBrokers", "fs://local"],
             "kafka", boot=FSKAFKA_BOOT,
-            env_extra={"FSKAFKA_DIR": str(broker)},
+            env_extra={"FSKAFKA_DIR": str(broker)}, file_sinks=False,
         )
+        perf_log = broker / "performance--0.log"
+        assert perf_log.exists(), "report not published to the topic"
+        report = json.loads(perf_log.read_text().strip().splitlines()[-1])
         s = _stat(report, 0)
         assert s["fitted"] + report["holdout"]["0"] == 2000
         assert s["score"] > 0.8
