@@ -116,18 +116,31 @@ class _DistPipeline:
     partition's holdout split, pending/forecast buffers and predictions."""
 
     def __init__(self, request: Request, raw_line: str, dim: int,
-                 trainer, test_cap: int, stage_cap: int):
+                 trainer, test_cap: int, stage_cap: int,
+                 sparse: bool = False, max_nnz: int = 0):
         self.request = request
         self.raw_line = raw_line  # original JSON, for checkpoint manifests
         self.dim = dim
         self.trainer = trainer
         self.stage_cap = stage_cap
-        self.test_set = ArrayHoldout(test_cap, dim)
+        # sparse (padded-COO) pipelines buffer (idx, val) row pairs — the
+        # reference's SparseVector data model works in its cluster
+        # deployment too (DataPointParser.scala:4,20-47)
+        self.sparse = sparse
+        self.max_nnz = max_nnz
+        if sparse:
+            from omldm_tpu.runtime.databuffers import SparseHoldout
+
+            self.test_set = SparseHoldout(test_cap, max_nnz)
+        else:
+            self.test_set = ArrayHoldout(test_cap, dim)
         self.holdout_count = 0
-        self.pend_x: List[np.ndarray] = []
+        self.pend_x: List[np.ndarray] = []   # dense rows, or COO idx
+        self.pend_v: List[np.ndarray] = []   # COO val (sparse only)
         self.pend_y: List[np.ndarray] = []
         self.pend_n = 0
-        self.fore_x: List[np.ndarray] = []
+        self.fore_x: List[np.ndarray] = []   # dense rows, or COO idx
+        self.fore_v: List[np.ndarray] = []   # COO val (sparse only)
         self.fore_n = 0
         self.predictions: List[float] = []
         self.steps_run = 0
@@ -176,6 +189,8 @@ class DistributedStreamJob:
         self.pipelines: Dict[int, _DistPipeline] = {}
         self.dim: Optional[int] = None  # stream width, set by first deploy
         self.hash_dims = 0  # trailing hashed-categorical slots within dim
+        self.stream_mode: Optional[str] = None  # "dense"|"sparse", pinned
+        self.sparse_hash_space = 0  # COO hashed tail width (sparse mode)
         self.responses: List[QueryResponse] = []
         self.response_merger = ResponseMerger(self.responses.append)
         self.orphan_predictions: List[Tuple[int, float]] = []
@@ -332,14 +347,23 @@ class DistributedStreamJob:
         from omldm_tpu.parallel.spmd import SPMDTrainer
 
         ds = (request.learner.data_structure if request.learner else None) or {}
-        if ds.get("sparse"):
+        sparse = bool(ds.get("sparse"))
+        if self.stream_mode is not None and (
+            (self.stream_mode == "sparse") != sparse
+        ):
             self._warn(
-                f"rejecting pipeline {request.id}: sparse (padded-COO) "
-                "pipelines run on the single-process SPMD bridge; the "
-                "multi-process data plane stages dense rows"
+                f"rejecting pipeline {request.id}: the stream is "
+                f"{self.stream_mode} (pinned by the first deploy) and a "
+                f"{'sparse' if sparse else 'dense'} pipeline cannot share "
+                "its parse route"
             )
             return
-        dim = self._request_dim(request)
+        if sparse:
+            # sparse widths are EXACT (hashSpace inside nFeatures); the
+            # dense hashDims knob does not apply to the COO path
+            dim = int(ds.get("nFeatures", 0)) or None
+        else:
+            dim = self._request_dim(request)
         if dim is None:
             self._warn(
                 f"rejecting pipeline {request.id}: distributed deployment "
@@ -369,16 +393,30 @@ class DistributedStreamJob:
         except ValueError as exc:
             self._warn(f"rejecting pipeline {request.id}: {exc}")
             return
-        hash_dims = int(tc.extra.get("hashDims", 0))
+        hash_dims = 0 if sparse else int(tc.extra.get("hashDims", 0))
         if self.dim is not None and hash_dims != self.hash_dims:
             self._warn(
                 f"rejecting pipeline {request.id}: hashDims {hash_dims} != "
                 f"stream hashDims {self.hash_dims} pinned by the first deploy"
             )
             return
+        max_nnz = int(ds.get("maxNnz", 40)) if sparse else 0
+        hash_space = int(ds.get("hashSpace", 0)) if sparse else 0
+        if sparse and self.pipelines:
+            pinned = next(iter(self.pipelines.values())).max_nnz
+            if max_nnz != pinned or hash_space != self.sparse_hash_space:
+                self._warn(
+                    f"rejecting pipeline {request.id}: COO layout "
+                    f"(maxNnz {max_nnz}, hashSpace {hash_space}) differs "
+                    "from the stream layout pinned by the first deploy"
+                )
+                return
         self.pipeline_manager.admit(request)
         self.dim = dim
         self.hash_dims = hash_dims
+        self.stream_mode = "sparse" if sparse else "dense"
+        if sparse:
+            self.sparse_hash_space = hash_space
         if request.id in self.pipelines:
             self._warn(
                 f"pipeline {request.id} replaced by "
@@ -388,6 +426,7 @@ class DistributedStreamJob:
             request, raw_line, dim, trainer,
             self.config.test_set_size,
             self.dp_local * self.config.batch_size,
+            sparse=sparse, max_nnz=max_nnz,
         )
 
     # --- data path: this process's partition only ---
@@ -430,6 +469,53 @@ class DistributedStreamJob:
             p.pend_y.append(np.asarray(y, np.float32))
             p.pend_n += x.shape[0]
 
+    def handle_partition_rows_sparse(
+        self, idx: np.ndarray, val: np.ndarray, y: np.ndarray
+    ) -> None:
+        """COO twin of :meth:`handle_partition_rows` (padded (idx, val)
+        rows from this partition, holdout-split per pipeline)."""
+        if idx.shape[0] == 0:
+            return
+        for p in self.pipelines.values():
+            self._buffer_rows_sparse(p, idx, val, y)
+
+    def _buffer_rows_sparse(self, p, idx, val, y) -> None:
+        if self.config.test:
+            n = idx.shape[0]
+            c = (p.holdout_count + np.arange(n)) % 10
+            p.holdout_count += n
+            test_mask = c >= 8
+            keep = np.nonzero(~test_mask)[0]
+            t_idx = np.nonzero(test_mask)[0]
+            ev_i, ev_v, ev_y, ev_src = p.test_set.append_many(
+                idx[t_idx], val[t_idx], y[t_idx]
+            )
+            if ev_src.size:
+                pos = np.concatenate([keep, t_idx[ev_src]])
+                order = np.argsort(pos, kind="stable")
+                idx = np.concatenate([idx[keep], ev_i])[order]
+                val = np.concatenate([val[keep], ev_v])[order]
+                y = np.concatenate([y[keep], ev_y])[order]
+            else:
+                idx, val, y = idx[keep], val[keep], y[keep]
+        else:
+            p.holdout_count += idx.shape[0]
+        if idx.shape[0]:
+            p.pend_x.append(np.asarray(idx, np.int32))
+            p.pend_v.append(np.asarray(val, np.float32))
+            p.pend_y.append(np.asarray(y, np.float32))
+            p.pend_n += idx.shape[0]
+
+    def handle_forecast_rows_sparse(
+        self, idx: np.ndarray, val: np.ndarray
+    ) -> None:
+        if idx.shape[0] == 0:
+            return
+        for p in self.pipelines.values():
+            p.fore_x.append(np.asarray(idx, np.int32))
+            p.fore_v.append(np.asarray(val, np.float32))
+            p.fore_n += idx.shape[0]
+
     def handle_forecast_rows(self, x: np.ndarray) -> None:
         """Buffer forecast rows from this partition for every pipeline;
         predictions are served collectively at the next :meth:`pump` (the
@@ -464,34 +550,47 @@ class DistributedStreamJob:
 
         from omldm_tpu.parallel.multihost import host_local_array
 
+        width = p.max_nnz if p.sparse else p.dim
         buf_x = (
             np.concatenate(p.pend_x)
             if p.pend_x
-            else np.zeros((0, p.dim), np.float32)
+            else np.zeros(
+                (0, width), np.int32 if p.sparse else np.float32
+            )
+        )
+        buf_v = (
+            np.concatenate(p.pend_v)
+            if p.sparse and p.pend_v
+            else np.zeros((0, width), np.float32)
         )
         buf_y = (
             np.concatenate(p.pend_y)
             if p.pend_y
             else np.zeros((0,), np.float32)
         )
-        p.pend_x, p.pend_y = [], []
-        requeued = []  # (x, y) blocks refused by the SSP bound this pump
+        p.pend_x, p.pend_v, p.pend_y = [], [], []
+        requeued = []  # row blocks refused by the SSP bound this pump
         done = 0
         staged = 0
         last_loss = None
         for _ in range(rounds):
             rows = min(cap, buf_x.shape[0] - done)
-            x = np.zeros((cap, p.dim), np.float32)
+            x = np.zeros(
+                (cap, width), np.int32 if p.sparse else np.float32
+            )
+            v = np.zeros((cap, width), np.float32) if p.sparse else None
             y = np.zeros((cap,), np.float32)
             mask = np.zeros((cap,), np.float32)
             if rows > 0:
                 x[:rows] = buf_x[done : done + rows]
+                if p.sparse:
+                    v[:rows] = buf_v[done : done + rows]
                 y[:rows] = buf_y[done : done + rows]
                 mask[:rows] = 1.0
             done += max(rows, 0)
             staged += max(rows, 0)
             x_d = host_local_array(
-                x.reshape(self.dp_local, b, p.dim), self.mesh, P("dp")
+                x.reshape(self.dp_local, b, width), self.mesh, P("dp")
             )
             y_d = host_local_array(
                 y.reshape(self.dp_local, b), self.mesh, P("dp")
@@ -499,12 +598,24 @@ class DistributedStreamJob:
             m_d = host_local_array(
                 mask.reshape(self.dp_local, b), self.mesh, P("dp")
             )
-            last_loss = p.trainer.step(x_d, y_d, m_d, valid_count=max(rows, 0))
+            if p.sparse:
+                v_d = host_local_array(
+                    v.reshape(self.dp_local, b, width), self.mesh, P("dp")
+                )
+                batch = (x_d, v_d)
+            else:
+                batch = x_d
+            last_loss = p.trainer.step(
+                batch, y_d, m_d, valid_count=max(rows, 0)
+            )
             p.steps_run += 1
             if p.trainer.protocol == "SSP":
                 self._requeue_refused(
                     p,
-                    x.reshape(self.dp_local, b, p.dim),
+                    x.reshape(self.dp_local, b, width),
+                    None if v is None else v.reshape(
+                        self.dp_local, b, width
+                    ),
                     y.reshape(self.dp_local, b),
                     mask.reshape(self.dp_local, b),
                     requeued,
@@ -516,14 +627,18 @@ class DistributedStreamJob:
         # SSP-refused rows collected during the loop (overwriting with the
         # tail alone would silently drop the requeued rows)
         p.pend_x = [buf_x[done:]] if done < buf_x.shape[0] else []
+        if p.sparse:
+            p.pend_v = [buf_v[done:]] if done < buf_x.shape[0] else []
         p.pend_y = [buf_y[done:]] if done < buf_x.shape[0] else []
         p.pend_n = max(buf_x.shape[0] - done, 0)
         requeued_rows = 0
-        for rx, ry in requeued:
-            p.pend_x.append(rx)
-            p.pend_y.append(ry)
-            p.pend_n += rx.shape[0]
-            requeued_rows += rx.shape[0]
+        for blk in requeued:
+            p.pend_x.append(blk[0])
+            if p.sparse:
+                p.pend_v.append(blk[1])
+            p.pend_y.append(blk[-1])
+            p.pend_n += blk[0].shape[0]
+            requeued_rows += blk[0].shape[0]
         # one pump-granularity learning-curve point: global mean loss of
         # the pump's last step + globally-consumed row count (two tiny
         # collectives per pump, not per step)
@@ -545,7 +660,7 @@ class DistributedStreamJob:
             p.global_rows += int(consumed)
             p.curve.append((loss_val, p.global_rows))
 
-    def _requeue_refused(self, p: _DistPipeline, xg, yg, mg, requeued) -> None:
+    def _requeue_refused(self, p: _DistPipeline, xg, vg, yg, mg, requeued) -> None:
         """SSP pacing across processes: the device refuses batches of
         workers past the staleness bound (state untouched, accepted=0);
         each process collects ITS OWN refused rows into ``requeued`` (the
@@ -569,10 +684,17 @@ class DistributedStreamJob:
             if k == 0:
                 continue
             p.trainer.note_requeued(k)
-            requeued.append((
-                np.asarray(xg[w][rows], np.float32),
-                np.asarray(yg[w][rows], np.float32),
-            ))
+            if p.sparse:
+                requeued.append((
+                    np.asarray(xg[w][rows], np.int32),
+                    np.asarray(vg[w][rows], np.float32),
+                    np.asarray(yg[w][rows], np.float32),
+                ))
+            else:
+                requeued.append((
+                    np.asarray(xg[w][rows], np.float32),
+                    np.asarray(yg[w][rows], np.float32),
+                ))
 
     def _pump_forecasts(self, p: _DistPipeline) -> None:
         """Agreed rounds of collective predict over buffered forecast
@@ -593,36 +715,66 @@ class DistributedStreamJob:
             def w0(tree):
                 return jax.tree_util.tree_map(lambda l: l[0, 0], tree)
 
-            def predict_fn(state, x):
-                d = x.shape[-1]
-                z = x.reshape(-1, d)
-                for prep, s in zip(t.preps, state["preps"]):
-                    z = prep.transform(w0(s), z)
-                return t.learner.predict(w0(state["params"]), z)
+            if p.sparse:
+
+                def predict_fn(state, i, v):
+                    k = i.shape[-1]
+                    z = (i.reshape(-1, k), v.reshape(-1, k))
+                    return t.learner.predict(w0(state["params"]), z)
+
+            else:
+
+                def predict_fn(state, x):
+                    d = x.shape[-1]
+                    z = x.reshape(-1, d)
+                    for prep, s in zip(t.preps, state["preps"]):
+                        z = prep.transform(w0(s), z)
+                    return t.learner.predict(w0(state["params"]), z)
 
             p._predict_jit = jax.jit(predict_fn, out_shardings=rep)
+        width = p.max_nnz if p.sparse else p.dim
         buf = (
             np.concatenate(p.fore_x)
             if p.fore_x
-            else np.zeros((0, p.dim), np.float32)
+            else np.zeros(
+                (0, width), np.int32 if p.sparse else np.float32
+            )
         )
-        p.fore_x, p.fore_n = [], 0
+        buf_v = (
+            np.concatenate(p.fore_v)
+            if p.sparse and p.fore_v
+            else np.zeros((0, width), np.float32)
+        )
+        p.fore_x, p.fore_v, p.fore_n = [], [], 0
         done = 0
         for _ in range(rounds):
             rows = min(cap, buf.shape[0] - done)
-            x = np.zeros((cap, p.dim), np.float32)
+            x = np.zeros(
+                (cap, width), np.int32 if p.sparse else np.float32
+            )
             if rows > 0:
                 x[:rows] = buf[done : done + rows]
             x_d = host_local_array(
-                x.reshape(self.dp_local, -1, p.dim), self.mesh, P("dp")
+                x.reshape(self.dp_local, -1, width), self.mesh, P("dp")
             )
-            preds = self._fetch_replicated(p._predict_jit(
-                p.trainer.state, x_d
-            ))
+            if p.sparse:
+                v = np.zeros((cap, width), np.float32)
+                if rows > 0:
+                    v[:rows] = buf_v[done : done + rows]
+                v_d = host_local_array(
+                    v.reshape(self.dp_local, -1, width), self.mesh, P("dp")
+                )
+                preds = self._fetch_replicated(p._predict_jit(
+                    p.trainer.state, x_d, v_d
+                ))
+            else:
+                preds = self._fetch_replicated(p._predict_jit(
+                    p.trainer.state, x_d
+                ))
             # the replicated output covers every process's rows; this
             # process's slice starts at pid * cap within the global batch
             mine = preds[self.pid * cap : self.pid * cap + max(rows, 0)]
-            p.predictions.extend(float(v) for v in mine)
+            p.predictions.extend(float(v_) for v_ in mine)
             done += max(rows, 0)
 
     def flush(self) -> None:
@@ -743,18 +895,34 @@ class DistributedStreamJob:
         from omldm_tpu.parallel.multihost import host_local_array
 
         cap = p.test_set.max_size
-        xs_l = np.zeros((self.dp_local, cap, p.dim), np.float32)
+        width = p.max_nnz if p.sparse else p.dim
+        xs_l = np.zeros(
+            (self.dp_local, cap, width), np.int32 if p.sparse else np.float32
+        )
+        vs_l = (
+            np.zeros((self.dp_local, cap, width), np.float32)
+            if p.sparse else None
+        )
         ys_l = np.zeros((self.dp_local, cap), np.float32)
         m_l = np.zeros((self.dp_local, cap), np.float32)
         n = len(p.test_set)
         if n:
-            xs, ys = p.test_set.arrays()
-            xs_l[0, :n] = xs
-            ys_l[0, :n] = ys
+            if p.sparse:
+                ti, tv, ty = p.test_set.arrays()
+                xs_l[0, :n] = ti
+                vs_l[0, :n] = tv
+                ys_l[0, :n] = ty
+            else:
+                xs, ys = p.test_set.arrays()
+                xs_l[0, :n] = xs
+                ys_l[0, :n] = ys
             m_l[0, :n] = 1.0
         x_d = host_local_array(xs_l, self.mesh, P("dp"))
         y_d = host_local_array(ys_l, self.mesh, P("dp"))
         m_d = host_local_array(m_l, self.mesh, P("dp"))
+        v_d = (
+            host_local_array(vs_l, self.mesh, P("dp")) if p.sparse else None
+        )
         if p._eval_jit is None:
             t = p.trainer
             rep = NamedSharding(self.mesh, P())
@@ -762,21 +930,39 @@ class DistributedStreamJob:
             def w0(tree):
                 return jax.tree_util.tree_map(lambda l: l[0, 0], tree)
 
-            def eval_fn(state, x, y, mask):
-                d = x.shape[-1]
-                z = x.reshape(-1, d)
-                yv = y.reshape(-1)
-                mv = mask.reshape(-1)
-                for prep, s in zip(t.preps, state["preps"]):
-                    z = prep.transform(w0(s), z)
-                params = w0(state["params"])
-                return (
-                    t.learner.loss(params, z, yv, mv),
-                    t.learner.score(params, z, yv, mv),
-                )
+            if p.sparse:
+
+                def eval_fn(state, i, v, y, mask):
+                    k = i.shape[-1]
+                    z = (i.reshape(-1, k), v.reshape(-1, k))
+                    yv = y.reshape(-1)
+                    mv = mask.reshape(-1)
+                    params = w0(state["params"])
+                    return (
+                        t.learner.loss(params, z, yv, mv),
+                        t.learner.score(params, z, yv, mv),
+                    )
+
+            else:
+
+                def eval_fn(state, x, y, mask):
+                    d = x.shape[-1]
+                    z = x.reshape(-1, d)
+                    yv = y.reshape(-1)
+                    mv = mask.reshape(-1)
+                    for prep, s in zip(t.preps, state["preps"]):
+                        z = prep.transform(w0(s), z)
+                    params = w0(state["params"])
+                    return (
+                        t.learner.loss(params, z, yv, mv),
+                        t.learner.score(params, z, yv, mv),
+                    )
 
             p._eval_jit = jax.jit(eval_fn, out_shardings=(rep, rep))
-        loss, score = p._eval_jit(p.trainer.state, x_d, y_d, m_d)
+        if p.sparse:
+            loss, score = p._eval_jit(p.trainer.state, x_d, v_d, y_d, m_d)
+        else:
+            loss, score = p._eval_jit(p.trainer.state, x_d, y_d, m_d)
         return (
             float(self._fetch_replicated(loss)),
             float(self._fetch_replicated(score)),
@@ -926,9 +1112,11 @@ class DistributedStreamJob:
             meta["responses"] = [r.to_dict() for r in self.responses]
         for net_id in sorted(self.pipelines):
             p = self.pipelines[net_id]
+            width = p.max_nnz if p.sparse else p.dim
+            xdt = np.int32 if p.sparse else np.float32
             pend_x = (
                 np.concatenate(p.pend_x)
-                if p.pend_x else np.zeros((0, p.dim), np.float32)
+                if p.pend_x else np.zeros((0, width), xdt)
             )
             pend_y = (
                 np.concatenate(p.pend_y)
@@ -936,17 +1124,37 @@ class DistributedStreamJob:
             )
             fore_x = (
                 np.concatenate(p.fore_x)
-                if p.fore_x else np.zeros((0, p.dim), np.float32)
-            )
-            tx, ty = (
-                p.test_set.arrays() if len(p.test_set)
-                else (np.zeros((0, p.dim), np.float32), np.zeros((0,), np.float32))
+                if p.fore_x else np.zeros((0, width), xdt)
             )
             arrays[f"n{net_id}_pend_x"] = pend_x
             arrays[f"n{net_id}_pend_y"] = pend_y
             arrays[f"n{net_id}_fore_x"] = fore_x
-            arrays[f"n{net_id}_test_x"] = np.asarray(tx, np.float32)
-            arrays[f"n{net_id}_test_y"] = np.asarray(ty, np.float32)
+            if p.sparse:
+                arrays[f"n{net_id}_pend_v"] = (
+                    np.concatenate(p.pend_v)
+                    if p.pend_v else np.zeros((0, width), np.float32)
+                )
+                arrays[f"n{net_id}_fore_v"] = (
+                    np.concatenate(p.fore_v)
+                    if p.fore_v else np.zeros((0, width), np.float32)
+                )
+                if len(p.test_set):
+                    ti, tv, ty = p.test_set.arrays()
+                else:
+                    ti = np.zeros((0, width), np.int32)
+                    tv = np.zeros((0, width), np.float32)
+                    ty = np.zeros((0,), np.float32)
+                arrays[f"n{net_id}_test_x"] = np.asarray(ti, np.int32)
+                arrays[f"n{net_id}_test_v"] = np.asarray(tv, np.float32)
+                arrays[f"n{net_id}_test_y"] = np.asarray(ty, np.float32)
+            else:
+                tx, ty = (
+                    p.test_set.arrays() if len(p.test_set)
+                    else (np.zeros((0, p.dim), np.float32),
+                          np.zeros((0,), np.float32))
+                )
+                arrays[f"n{net_id}_test_x"] = np.asarray(tx, np.float32)
+                arrays[f"n{net_id}_test_y"] = np.asarray(ty, np.float32)
             meta["pipelines"][str(net_id)] = {
                 "holdout_count": p.holdout_count,
                 "fitted": p.trainer.fitted,
@@ -1069,19 +1277,56 @@ class DistributedStreamJob:
             px = arrays[f"n{net_id}_pend_x"]
             if px.shape[0]:
                 p.pend_x = [px]
+                if p.sparse:
+                    p.pend_v = [arrays[f"n{net_id}_pend_v"]]
                 p.pend_y = [arrays[f"n{net_id}_pend_y"]]
                 p.pend_n = int(px.shape[0])
             fx = arrays[f"n{net_id}_fore_x"]
             if fx.shape[0]:
                 p.fore_x = [fx]
+                if p.sparse:
+                    p.fore_v = [arrays[f"n{net_id}_fore_v"]]
                 p.fore_n = int(fx.shape[0])
             tx = arrays[f"n{net_id}_test_x"]
             if tx.shape[0]:
-                p.test_set.append_many(tx, arrays[f"n{net_id}_test_y"])
+                if p.sparse:
+                    p.test_set.append_many(
+                        tx, arrays[f"n{net_id}_test_v"],
+                        arrays[f"n{net_id}_test_y"],
+                    )
+                else:
+                    p.test_set.append_many(tx, arrays[f"n{net_id}_test_y"])
         return meta["cursor"]
 
 
 # --- drive loops -----------------------------------------------------------
+
+
+def _manifest_is_sparse(flags: Dict[str, str]) -> bool:
+    """Restores skip the requests file, so the drive-mode choice sniffs
+    the snapshot manifest's recorded Create lines."""
+    root = flags.get("checkpointDir")
+    if not root:
+        return False
+    latest = os.path.join(root, "LATEST")
+    if not os.path.exists(latest):
+        return False
+    with open(latest, "rb") as f:
+        d = os.path.join(root, f.read().decode().strip())
+    try:
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+    except OSError:
+        return False
+    for line in manifest.get("request_lines", []):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        ds = (obj.get("learner") or {}).get("dataStructure") or {}
+        if ds.get("sparse"):
+            return True
+    return False
 
 
 def _flag_true(flags: Dict[str, str], key: str) -> bool:
@@ -1110,6 +1355,134 @@ def _maybe_checkpoint_and_fail(
             flush=True,
         )
         os._exit(3)
+
+
+def _sparse_tools(job: DistributedStreamJob):
+    """(SparseFastParser, SparseVectorizer) for the job's pinned COO
+    layout — shared by the file and Kafka sparse drives."""
+    from omldm_tpu.ops.native import SparseFastParser
+    from omldm_tpu.runtime.vectorizer import SparseVectorizer
+
+    p0 = next(iter(job.pipelines.values()))
+    dense_budget = job.dim - job.sparse_hash_space
+    parser = SparseFastParser(
+        dense_budget, job.sparse_hash_space, p0.max_nnz
+    )
+    vec = SparseVectorizer(job.dim, job.sparse_hash_space, p0.max_nnz)
+    return parser, vec
+
+
+def _consume_sparse_block(
+    job: DistributedStreamJob, parser, vec, block: bytes,
+    line_base: int, nproc: int, pid: int, force_forecast: bool = False,
+) -> int:
+    """Parse a line-aligned COO block, keep this process's stride (row
+    line_base+i belongs to process (line_base+i) % nproc — pass nproc=1
+    for Kafka mode, where partition assignment already partitioned the
+    stream), and buffer train/forecast rows for every pipeline. Rows the
+    C parser defers (valid == 2: escaped categoricals, odd shapes) route
+    through the Python codec at their stream position. Returns the number
+    of lines consumed."""
+    from omldm_tpu.api.data import FORECASTING, DataInstance
+    from omldm_tpu.runtime.vectorizer import F32_MAX
+
+    idx, val, y, op, valid = parser.parse(block)
+    n = idx.shape[0]
+    if n == 0:
+        return 0
+    gidx = line_base + np.arange(n)
+    mine = (gidx % nproc) == pid
+    fast = mine & (valid == 1)
+    if force_forecast:
+        fore = fast
+        train = np.zeros_like(fast)
+    else:
+        train = fast & (op == 0)
+        fore = fast & (op != 0)
+    # specials interleave with fast rows in stream order (same contract
+    # as the single-process COO bridge): split the block at fallback rows
+    fb = np.nonzero(mine & (valid == 2))[0]
+    if not fb.size:
+        if train.any():
+            job.handle_partition_rows_sparse(idx[train], val[train], y[train])
+        if fore.any():
+            job.handle_forecast_rows_sparse(idx[fore], val[fore])
+        return n
+    lines = block.split(b"\n")
+    prev = 0
+    for s in list(fb) + [n]:
+        s = int(s)
+        seg = slice(prev, s)
+        seg_train = train[seg]
+        seg_fore = fore[seg]
+        if seg_train.any():
+            job.handle_partition_rows_sparse(
+                idx[seg][seg_train], val[seg][seg_train], y[seg][seg_train]
+            )
+        if seg_fore.any():
+            job.handle_forecast_rows_sparse(
+                idx[seg][seg_fore], val[seg][seg_fore]
+            )
+        if s >= n:
+            break
+        inst = DataInstance.from_json(
+            lines[s].decode("utf-8", errors="replace")
+        )
+        if inst is not None:
+            i1, v1 = vec.vectorize(inst)
+            if force_forecast or inst.operation == FORECASTING:
+                job.handle_forecast_rows_sparse(i1[None], v1[None])
+            else:
+                yv = (
+                    0.0 if inst.target is None
+                    else float(min(max(float(inst.target), -F32_MAX), F32_MAX))
+                )
+                job.handle_partition_rows_sparse(
+                    i1[None], v1[None], np.asarray([yv], np.float32)
+                )
+        prev = s + 1
+    return n
+
+
+def _drive_file_sparse(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
+    """Sparse (padded-COO) file drive: line-aligned chunks through the C
+    COO parser, row i striped to process i % nproc — the sparse twin of
+    the dense strided drive. Checkpoint cursors record the line-aligned
+    BYTE offset plus the global line count (both needed: bytes to seek,
+    lines to keep the stripe phase)."""
+    from omldm_tpu.runtime.spmd_bridge import _line_aligned_chunks
+
+    resume = {"bytes": 0, "lines": 0}
+    if _flag_true(flags, "restore") and flags.get("checkpointDir"):
+        cur = job.restore_checkpoint(flags["checkpointDir"])
+        if cur is not None:
+            resume = dict(cur)
+            job._warn(f"restored; resuming at {resume}")
+    assert job.dim is not None, "no pipeline deployed and no snapshot found"
+    parser, vec = _sparse_tools(job)
+    chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
+    # size chunks in bytes from a crude per-line estimate; pump cadence
+    # only needs to be IDENTICAL across processes, which byte-chunking is
+    chunk_bytes = max(chunk_rows * 256, 1 << 16)
+    consumed = int(resume["bytes"])
+    line_base = int(resume["lines"])
+    chunk_idx = 0
+    for buf, stop in _line_aligned_chunks(
+        flags["trainingData"], chunk_bytes, start_offset=consumed
+    ):
+        block = bytes(memoryview(buf)[:stop])
+        n = _consume_sparse_block(
+            job, parser, vec, block, line_base, job.nproc, job.pid
+        )
+        line_base += n
+        consumed += stop
+        job.pump()
+        _maybe_checkpoint_and_fail(
+            job, flags, chunk_idx,
+            {"bytes": consumed, "lines": line_base},
+        )
+        chunk_idx += 1
+    job.flush()
 
 
 def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
@@ -1308,17 +1681,25 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     # batchers are built once the stream width is known (the first Create
     # may arrive on the requests topic mid-run); until then data partitions
     # are simply not polled, so their offsets — and the records — wait in
-    # the broker exactly as they would for a slow Flink subtask
+    # the broker exactly as they would for a slow Flink subtask. A sparse
+    # stream swaps in the COO parser (partition assignment already
+    # partitioned the stream, so no row striding: nproc=1 in the helper).
     batchers: Dict[str, Any] = {}
+    sparse_tools = [None]
 
     def _ensure_batchers():
         if not batchers and job.dim is not None:
-            batchers[train_topic] = PackedBatcher(
-                job.dim, chunk_rows, job.hash_dims
-            )
-            batchers[fore_topic] = PackedBatcher(
-                job.dim, chunk_rows, job.hash_dims
-            )
+            if job.stream_mode == "sparse":
+                sparse_tools[0] = _sparse_tools(job)
+                batchers[train_topic] = "sparse"
+                batchers[fore_topic] = "sparse"
+            else:
+                batchers[train_topic] = PackedBatcher(
+                    job.dim, chunk_rows, job.hash_dims
+                )
+                batchers[fore_topic] = PackedBatcher(
+                    job.dim, chunk_rows, job.hash_dims
+                )
         return bool(batchers)
 
     def _feed(topic, batches):
@@ -1331,6 +1712,17 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
                     job.handle_partition_rows(bx[train], by[train])
                 if (~train).any():
                     job.handle_forecast_rows(bx[~train])
+
+    def _feed_window(topic, wb):
+        """One bulk parse per topic per poll window."""
+        if batchers[topic] == "sparse":
+            parser, vec = sparse_tools[0]
+            _consume_sparse_block(
+                job, parser, vec, bytes(wb), 0, 1, 0,
+                force_forecast=(topic == fore_topic),
+            )
+        else:
+            _feed(topic, batchers[topic].feed_buffer(wb, 0, len(wb)))
 
     chunk_idx = 0
     idle_windows = 0
@@ -1385,8 +1777,10 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
                 wb += b"\n"
         for topic, wb in win_bufs.items():
             if wb:
-                _feed(topic, batchers[topic].feed_buffer(wb, 0, len(wb)))
+                _feed_window(topic, wb)
         for topic, b in batchers.items():
+            if b == "sparse":
+                continue  # the COO parser consumes whole windows, no tail
             tail = b.flush()
             if tail:
                 _feed(topic, [tail])
@@ -1499,7 +1893,12 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
                 "least one valid Create/Update with "
                 f"dataStructure.nFeatures ({flags.get('requests')!r})"
             )
-        _drive_file(job, flags)
+        if job.stream_mode == "sparse" or (
+            restoring and _manifest_is_sparse(flags)
+        ):
+            _drive_file_sparse(job, flags)
+        else:
+            _drive_file(job, flags)
 
     # post-training control-plane sync point: a second request file handled
     # after the stream drains (deterministic query-after-training — the
