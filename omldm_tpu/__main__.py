@@ -155,7 +155,10 @@ def _enable_compile_cache(flags: Dict[str, str]) -> None:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     flags = parse_flags(argv)
-    if any(k in flags for k in ("processes", "processId", "coordinator")):
+    if any(
+        k in flags
+        for k in ("processes", "processId", "coordinator", "supervise")
+    ):
         # multi-process deployment: one entry point for both shapes
         # (Job.scala:110-120 — the reference has exactly one main); each
         # process runs the same command with its own --processId
@@ -254,19 +257,39 @@ def _kafka_loop(job: StreamJob, events, flags: Dict[str, str], profile: Dict) ->
             break
 
 
+def _kafka_retry_policies(flags: Dict[str, str]):
+    """(connect/metadata policy, producer-send policy) from the CLI knobs
+    ``--retry{Attempts,BaseDelayMs,Growth,JitterMs,TimeoutMs}`` and
+    ``--sendRetry{...}`` (defaults in kafka_io)."""
+    import dataclasses
+
+    from omldm_tpu.runtime.kafka_io import CONNECT_RETRY, SEND_RETRY
+    from omldm_tpu.utils.backoff import BackoffPolicy
+
+    connect = BackoffPolicy.from_flags(
+        flags, "retry", **dataclasses.asdict(CONNECT_RETRY)
+    )
+    send = BackoffPolicy.from_flags(
+        flags, "sendRetry", **dataclasses.asdict(SEND_RETRY)
+    )
+    return connect, send
+
+
 def _run_kafka(job: StreamJob, flags: Dict[str, str]) -> int:
     """The live Kafka job, optionally supervised (--restartAttempts N):
     on failure, restore the latest checkpoint taken during this run and
     seek the rebuilt consumer to the snapshot's (topic, partition) offsets
     — Flink's restore-from-checkpoint with Kafka source offsets. Without a
     usable snapshot the incarnation restarts fresh from the live position
-    (no replay), Flink's uncheckpointed behavior on a live source."""
-    import time as _time
-
+    (no replay), Flink's uncheckpointed behavior on a live source. The
+    restart loop itself runs under the shared backoff helper (fixed delay,
+    bounded attempts — RestartStrategies.fixedDelayRestart)."""
     from omldm_tpu.runtime.kafka_io import connect_kafka
+    from omldm_tpu.utils.backoff import with_backoff
 
     attempts = int(flags.get("restartAttempts", "0"))
     delay_s = float(flags.get("restartDelayMs", "0")) / 1000.0
+    connect_retry, send_retry = _kafka_retry_policies(flags)
     # bounded profile window for the unbounded stream: trace only the
     # first --profileSteps events (default 1000)
     profile = {
@@ -284,55 +307,72 @@ def _run_kafka(job: StreamJob, flags: Dict[str, str]) -> int:
     ckpt_floor = manager.latest_path() if manager is not None else None
     tracker: Dict = {}
     events, producer_sinks = connect_kafka(
-        flags["kafkaBrokers"], tracker=tracker
+        flags["kafkaBrokers"], tracker=tracker,
+        retry=connect_retry, send_retry=send_retry,
     )
-    failures = 0
+    # mutable attempt state: each restart swaps in the recovered job and
+    # the reconnected clients for the next with_backoff attempt
+    state = {"job": job, "events": events, "sinks": producer_sinks,
+             "tracker": tracker}
+
+    def _attempt() -> int:
+        j = state["job"]
+        j.source_position = state["tracker"]
+        _apply_kafka_sinks(j, flags, state["sinks"])
+        _kafka_loop(j, state["events"], flags, profile)
+        return 0
+
+    def _on_restart(exc: Exception, next_attempt: int) -> None:
+        print(
+            f"job failure ({type(exc).__name__}: {exc}); "
+            f"restart {next_attempt - 1}/{attempts}",
+            file=sys.stderr,
+        )
+        from omldm_tpu.runtime.recovery import recover_job
+
+        new_job, _restored_from = recover_job(state["job"], ckpt_floor)
+        if new_job.source_position is None:
+            # fresh incarnation: data streams continue from the
+            # live position (no replay on a live source), but the
+            # CONTROL stream rewinds to the beginning — a
+            # fresh-state job must re-consume Create/Update/Delete
+            # requests to rebuild its topology (the reference's
+            # topology is part of the submitted job graph; here it
+            # is request-driven). Dropping the key makes the
+            # reconnect seek those partitions to the beginning.
+            position = dict(state["tracker"])
+            from omldm_tpu.runtime.kafka_io import DEFAULT_TOPICS
+
+            for key in list(position):
+                if DEFAULT_TOPICS.get(key[0]) == REQUEST_STREAM:
+                    del position[key]
+            new_job.source_position = position
+        tracker = dict(new_job.source_position)
+        # close the abandoned clients: restarts must not leak
+        # broker connections / fetcher threads
+        state["sinks"].close()
+        new_events, new_sinks = connect_kafka(
+            flags["kafkaBrokers"],
+            position=tracker,
+            tracker=tracker,
+            retry=connect_retry,
+            send_retry=send_retry,
+        )
+        state.update(
+            job=new_job, events=new_events, sinks=new_sinks, tracker=tracker
+        )
+
     try:
-        while True:
-            job.source_position = tracker
-            _apply_kafka_sinks(job, flags, producer_sinks)
-            try:
-                _kafka_loop(job, events, flags, profile)
-                return 0
-            except Exception as exc:
-                failures += 1
-                if failures > attempts:
-                    raise
-                print(
-                    f"job failure ({type(exc).__name__}: {exc}); "
-                    f"restart {failures}/{attempts}",
-                    file=sys.stderr,
-                )
-                if delay_s > 0:
-                    _time.sleep(delay_s)
-                from omldm_tpu.runtime.recovery import recover_job
-
-                job, restored_from = recover_job(job, ckpt_floor)
-                if job.source_position is None:
-                    # fresh incarnation: data streams continue from the
-                    # live position (no replay on a live source), but the
-                    # CONTROL stream rewinds to the beginning — a
-                    # fresh-state job must re-consume Create/Update/Delete
-                    # requests to rebuild its topology (the reference's
-                    # topology is part of the submitted job graph; here it
-                    # is request-driven). Dropping the key makes the
-                    # reconnect seek those partitions to the beginning.
-                    position = dict(tracker)
-                    from omldm_tpu.runtime.kafka_io import DEFAULT_TOPICS
-
-                    for key in list(position):
-                        if DEFAULT_TOPICS.get(key[0]) == REQUEST_STREAM:
-                            del position[key]
-                    job.source_position = position
-                tracker = dict(job.source_position)
-                # close the abandoned clients: restarts must not leak
-                # broker connections / fetcher threads
-                producer_sinks.close()
-                events, producer_sinks = connect_kafka(
-                    flags["kafkaBrokers"],
-                    position=tracker,
-                    tracker=tracker,
-                )
+        # fixed-delay restart strategy over the whole live loop —
+        # RestartStrategies.fixedDelayRestart(attempts, delay) semantics
+        return with_backoff(
+            _attempt,
+            attempts=attempts + 1,
+            base_delay=delay_s,
+            growth=1.0,
+            retry_on=(Exception,),
+            on_retry=_on_restart,
+        )
     finally:
         if profile["tracing"]:
             import jax

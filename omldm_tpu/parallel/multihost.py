@@ -34,10 +34,38 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _enable_cpu_collectives(enable: bool = True) -> None:
+    """Multi-process groups on the CPU backend need an explicit
+    cross-process collectives implementation (gloo) on jax releases that
+    ship it opt-in — without it every collective fails with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Gloo needs the jax.distributed client, so it must be switched back OFF
+    (``enable=False``) when no process group forms — a single-process run
+    with the knob stuck on cannot even initialize the CPU backend. No-op
+    on TPU/GPU and on releases without the knob."""
+    import os
+
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
+    )
+    if "cpu" not in platforms.split(","):
+        return
+    try:
+        jax.config.update(
+            "jax_cpu_collectives_implementation", "gloo" if enable else "none"
+        )
+    except Exception:
+        pass  # newer jax: gloo is the built-in default, knob removed
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    connect_attempts: int = 3,
+    connect_timeout_s: Optional[float] = None,
 ) -> Tuple[int, int]:
     """Join the jax.distributed process group; returns (process_id,
     process_count). Call FIRST, before anything that initializes the XLA
@@ -47,20 +75,48 @@ def initialize_multihost(
     With explicit args the process group is joined directly (manual
     clusters); with no args JAX's own auto-detection runs (Cloud TPU
     metadata, Slurm, Open MPI) and a failed detection falls back to
-    single-process (0, 1) — so the same call is safe on a laptop."""
+    single-process (0, 1) — so the same call is safe on a laptop.
+
+    The explicit join retries under the shared backoff helper
+    (``connect_attempts`` tries; ``connect_timeout_s`` bounds the whole
+    join) — a worker relaunched by the supervisor a beat before its peers
+    must not die just because the coordinator port is not up yet."""
     if coordinator_address is not None or num_processes is not None:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
+        from omldm_tpu.utils.backoff import with_backoff
+
+        _enable_cpu_collectives(enable=(num_processes or 1) > 1)
+        kwargs = {}
+        if connect_timeout_s is not None:
+            # the overall deadline bounds the whole join; each ATTEMPT gets
+            # its share, else the first attempt eats the budget and the
+            # advertised retries can never run
+            kwargs["initialization_timeout"] = max(
+                int(connect_timeout_s / max(connect_attempts, 1)), 1
+            )
+        with_backoff(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            ),
+            attempts=connect_attempts,
+            base_delay=0.5,
+            growth=2.0,
+            jitter=0.25,
+            timeout=connect_timeout_s,
+            retry_on=(RuntimeError,),
         )
         return jax.process_index(), jax.process_count()
     try:
+        _enable_cpu_collectives()
         jax.distributed.initialize()  # cluster auto-detection
     except Exception:
         # no cluster found, or the backend was already initialized (e.g. a
-        # single-host run that did jax work first): report what exists
-        pass
+        # single-host run that did jax work first): report what exists —
+        # and withdraw the gloo request, which cannot work without the
+        # process-group client
+        _enable_cpu_collectives(enable=False)
     return jax.process_index(), jax.process_count()
 
 

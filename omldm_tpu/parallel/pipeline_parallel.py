@@ -24,6 +24,8 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import jax
+
+from omldm_tpu.utils.jaxcompat import axis_size, shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -38,11 +40,7 @@ from omldm_tpu.parallel.optim import adam_opt_specs, adam_update, init_adam_stat
 from omldm_tpu.ops.attention import attention
 
 
-def _pvary(x, axes):
-    """Invariant -> varying cast (pvary was deprecated in favor of pcast)."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+from omldm_tpu.utils.jaxcompat import pvary as _pvary
 
 
 def make_pp_mesh(dp: int = 1, pp: int = 1, devices=None) -> Mesh:
@@ -102,7 +100,7 @@ def pp_lm_loss(
     """Global-mean LM loss of the pipelined forward. Runs INSIDE shard_map
     over a ("dp", "pp") mesh."""
     params = cast_params(params, cfg.dtype)
-    n = jax.lax.axis_size(pp_axis)
+    n = axis_size(pp_axis)
     i = jax.lax.axis_index(pp_axis)
     m = tokens.shape[0]
     lc = tokens.shape[2]
@@ -220,7 +218,7 @@ class PPTrainer:
             return new_params, new_opt, loss
 
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step_impl,
                 mesh=self.mesh,
                 in_specs=(pspecs, ospecs, data_spec, data_spec, data_spec),

@@ -26,6 +26,8 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+
+from omldm_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -142,7 +144,7 @@ class SeqTrainer:
         # intermediate varies over, so jax.grad's transpose inserts the
         # gradient psums for replicated parameter leaves automatically —
         # the manual alternative double-counts shared paths under tp.
-        step = jax.shard_map(
+        step = shard_map(
             self._step_impl,
             mesh=self.mesh,
             in_specs=(pspecs, ospecs, data_spec, label_spec, data_spec),
@@ -217,7 +219,7 @@ class SeqTrainer:
                 return params, opt, losses
 
             self._step_many = jax.jit(
-                jax.shard_map(
+                shard_map(
                     many_impl,
                     mesh=self.mesh,
                     in_specs=(

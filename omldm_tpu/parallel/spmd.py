@@ -51,6 +51,8 @@ from __future__ import annotations
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
+
+from omldm_tpu.utils.jaxcompat import shard_map
 import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
@@ -63,11 +65,7 @@ from omldm_tpu.parallel.mesh import make_mesh
 from omldm_tpu.utils import batch_valid_counts
 
 
-def _pvary(x, axes):
-    """Invariant -> varying cast (pvary was deprecated in favor of pcast)."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+from omldm_tpu.utils.jaxcompat import pvary as _pvary
 
 SPMD_PROTOCOLS = (
     "Synchronous",
@@ -175,7 +173,7 @@ class SPMDTrainer:
         self._step_many_dense = None  # lazily too (mask-free bulk variant)
         batch_spec = P("dp")
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step_impl,
                 mesh=self.mesh,
                 in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
@@ -476,7 +474,7 @@ class SPMDTrainer:
                 return jax.lax.scan(body, state, (xs, ys, masks))
 
             self._step_many = jax.jit(
-                jax.shard_map(
+                shard_map(
                     many_impl,
                     mesh=self.mesh,
                     in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
@@ -514,7 +512,7 @@ class SPMDTrainer:
                 return jax.lax.scan(body, state, (xs, ys))
 
             self._step_many_dense = jax.jit(
-                jax.shard_map(
+                shard_map(
                     many_dense_impl,
                     mesh=self.mesh,
                     in_specs=(self._state_specs, batch_spec, batch_spec),

@@ -1204,20 +1204,139 @@ class DistributedStreamJob:
         self.barrier()  # nobody races ahead of the visible pointer
         return d
 
+    def _checkpoint_candidates(self, root: str) -> List[Tuple[int, str]]:
+        """(seq, dir) of every snapshot under ``root``, newest first."""
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.startswith("ckpt-"):
+                continue
+            try:
+                out.append(
+                    (int(name.split("-", 1)[1]), os.path.join(root, name))
+                )
+            except ValueError:
+                continue
+        return sorted(out, reverse=True)
+
+    def _validate_checkpoint(self, d: str) -> Optional[dict]:
+        """Fully load-check every file THIS process needs from snapshot
+        ``d`` (manifest, its own proc shard pair, the fleet files);
+        returns the manifest, or None — with the reason logged — when any
+        file is missing, truncated, or undecodable. Loading every array
+        is deliberate: a torn npz can open fine and fail only when its
+        members decompress, and restore must never half-load."""
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest = json.load(f)
+            net_ids = [
+                int(json.loads(line)["id"])
+                for line in manifest["request_lines"]
+            ]
+            with open(os.path.join(d, f"proc{self.pid}.json")) as f:
+                json.load(f)
+            paths = [os.path.join(d, f"proc{self.pid}.npz")] + [
+                os.path.join(d, f"fleet_{net_id}.npz") for net_id in net_ids
+            ]
+            for path in paths:
+                with np.load(path) as z:
+                    for key in z.files:
+                        _ = z[key]
+            return manifest
+        except Exception as exc:
+            self._warn(
+                f"snapshot {os.path.basename(d)} failed validation: "
+                f"{type(exc).__name__}: {exc}"
+            )
+            return None
+
+    def _agree_restore_target(
+        self, root: str
+    ) -> Tuple[Optional[str], Optional[dict]]:
+        """Pick the newest snapshot EVERY process can fully load. Each
+        process validates candidates newest-first; the fleet agrees on the
+        min of the per-process bests, re-validating until one snapshot is
+        good everywhere — a corrupt/truncated/withheld shard on any
+        process falls the whole fleet back to the previous complete
+        snapshot instead of crashing or half-loading (the role of Flink
+        discarding an incomplete checkpoint and restoring the last
+        COMPLETED one)."""
+        ceiling: Optional[int] = None
+        while True:
+            local_seq, local_manifest = -1, None
+            for seq, d in self._checkpoint_candidates(root):
+                if ceiling is not None and seq > ceiling:
+                    continue
+                manifest = self._validate_checkpoint(d)
+                if manifest is not None:
+                    local_seq, local_manifest = seq, manifest
+                    break
+            # fleet minimum of the per-process newest-valid seq
+            agreed = int(round(
+                -self._collective_reduce([-float(local_seq)], "max")[0]
+            ))
+            if agreed < 0:
+                return None, None
+            if agreed != local_seq:
+                local_manifest = self._validate_checkpoint(
+                    os.path.join(root, f"ckpt-{agreed}")
+                )
+            ok = 1.0 if local_manifest is not None else 0.0
+            all_ok = -self._collective_reduce([-ok], "max")[0]
+            if all_ok > 0.5:
+                return os.path.join(root, f"ckpt-{agreed}"), local_manifest
+            ceiling = agreed - 1
+
     def restore_checkpoint(self, root: str) -> Optional[Any]:
-        """Resume every process from the latest consistent snapshot;
-        returns this process's saved cursor (None when no snapshot
+        """Resume every process from the latest CONSISTENT snapshot;
+        returns this process's saved cursor (None when no usable snapshot
         exists). Must be called before any data is consumed, by every
-        process (the fleet-state placement is collective)."""
+        process (the fleet-state placement — and the agreement on which
+        snapshot is loadable everywhere — is collective). A snapshot with
+        a corrupt/truncated/missing shard is skipped in favor of the
+        previous complete one; the LATEST pointer is repointed and the
+        unusable snapshots pruned so later incarnations never retry
+        them."""
         import jax
 
         latest = os.path.join(root, "LATEST")
-        if not os.path.exists(latest):
+        if not os.path.exists(latest) and not self._checkpoint_candidates(
+            root
+        ):
             return None
-        with open(latest, "rb") as f:
-            d = os.path.join(root, f.read().decode().strip())
-        with open(os.path.join(d, "manifest.json")) as f:
-            manifest = json.load(f)
+        d, manifest = self._agree_restore_target(root)
+        if d is None:
+            self._warn(
+                "no usable distributed snapshot (every candidate failed "
+                "validation on some process); starting fresh"
+            )
+            return None
+        pointed = d
+        if os.path.exists(latest):
+            with open(latest, "rb") as f:
+                pointed = os.path.join(root, f.read().decode().strip())
+        if os.path.abspath(pointed) != os.path.abspath(d):
+            self._warn(
+                f"falling back from {os.path.basename(pointed)} to "
+                f"{os.path.basename(d)} (newer snapshot incomplete)"
+            )
+            if self.pid == 0:
+                # repoint + prune: the unusable snapshots must not be
+                # retried by a later incarnation, and the next save reuses
+                # their seq numbers
+                import shutil
+
+                chosen_seq = int(os.path.basename(d).split("-", 1)[1])
+                for seq, cand in self._checkpoint_candidates(root):
+                    if seq > chosen_seq:
+                        shutil.rmtree(cand, ignore_errors=True)
+                _atomic_write_bytes(
+                    latest, os.path.basename(d).encode()
+                )
+            self.barrier()  # nobody proceeds past a half-pruned root
         if manifest["processes"] != self.nproc:
             raise ValueError(
                 f"snapshot taken with {manifest['processes']} processes; "
@@ -1304,28 +1423,43 @@ class DistributedStreamJob:
 
 def _manifest_is_sparse(flags: Dict[str, str]) -> bool:
     """Restores skip the requests file, so the drive-mode choice sniffs
-    the snapshot manifest's recorded Create lines."""
+    the snapshot manifests' recorded Create lines. Sparsity is a
+    job-level property (the stream mode is pinned by the first deploy and
+    recorded in every snapshot), so when the newest manifest is
+    unreadable — the corrupt-snapshot case restore itself falls back
+    from — ANY readable candidate answers the question."""
     root = flags.get("checkpointDir")
     if not root:
         return False
+    candidates = []
     latest = os.path.join(root, "LATEST")
-    if not os.path.exists(latest):
-        return False
-    with open(latest, "rb") as f:
-        d = os.path.join(root, f.read().decode().strip())
+    if os.path.exists(latest):
+        with open(latest, "rb") as f:
+            candidates.append(os.path.join(root, f.read().decode().strip()))
     try:
-        with open(os.path.join(d, "manifest.json")) as f:
-            manifest = json.load(f)
+        names = [
+            n for n in os.listdir(root)
+            if n.startswith("ckpt-") and n.split("-", 1)[1].isdigit()
+        ]
     except OSError:
-        return False
-    for line in manifest.get("request_lines", []):
+        names = []
+    names.sort(key=lambda n: -int(n.split("-", 1)[1]))
+    candidates += [os.path.join(root, n) for n in names]
+    for d in candidates:
         try:
-            obj = json.loads(line)
-        except ValueError:
-            continue
-        ds = (obj.get("learner") or {}).get("dataStructure") or {}
-        if ds.get("sparse"):
-            return True
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            continue  # unreadable: restore falls back the same way
+        for line in manifest.get("request_lines", []):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            ds = (obj.get("learner") or {}).get("dataStructure") or {}
+            if ds.get("sparse"):
+                return True
+        return False  # first READABLE manifest decides
     return False
 
 
@@ -1333,28 +1467,68 @@ def _flag_true(flags: Dict[str, str], key: str) -> bool:
     return flags.get(key, "").lower() in ("true", "1", "yes")
 
 
-def _maybe_checkpoint_and_fail(
-    job: DistributedStreamJob, flags: Dict[str, str],
-    chunk_idx: int, cursor: Any,
+def _heartbeat(flags: Dict[str, str], pid: int) -> None:
+    """Touch this process's heartbeat file (the supervisor's liveness
+    channel). Called at every synchronized pump point, so a process wedged
+    in a collective (peer died) stops beating and gets detected."""
+    d = flags.get("heartbeatDir")
+    if not d:
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"proc{pid}.hb"), "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass  # a full/odd disk must not kill the job over telemetry
+
+
+def _make_injector(job: DistributedStreamJob, flags: Dict[str, str]):
+    from omldm_tpu.runtime.supervisor import DistributedFaultInjector
+
+    return DistributedFaultInjector(flags, job.pid)
+
+
+def _sync_requests_from_flags(
+    job: DistributedStreamJob, flags: Dict[str, str]
 ) -> None:
-    """Synchronized checkpoint cadence + deterministic fault injection.
-    Every process evaluates the same condition at the same chunk index, so
-    checkpoints are collective-consistent and an injected crash kills the
-    whole deployment at one cut (the supervisor then relaunches every
-    process with --restore, Flink's global-restart strategy)."""
+    """Deploy the --requests file (process 0 reads, everyone syncs)."""
+    lines: List[str] = []
+    if job.pid == 0 and flags.get("requests"):
+        with open(flags["requests"]) as f:
+            lines = [l.strip() for l in f if l.strip()]
+    job.sync_requests(lines)
+
+
+def _restore_or_fresh(job: DistributedStreamJob, flags: Dict[str, str]):
+    """Restore the latest consistent snapshot; when NO candidate is usable
+    (all corrupt/withheld — restore_checkpoint already warned), degrade to
+    a fresh run by redeploying the requests file instead of dying with no
+    pipelines — Flink's behavior for a job restarted without a completed
+    checkpoint. Returns the restored cursor or None."""
+    cur = job.restore_checkpoint(flags["checkpointDir"])
+    if cur is None and not job.pipelines:
+        _sync_requests_from_flags(job, flags)
+    return cur
+
+
+def _chunk_tick(
+    job: DistributedStreamJob, flags: Dict[str, str],
+    chunk_idx: int, cursor: Any, injector, records: int = 0,
+) -> None:
+    """One synchronized pump point: heartbeat, checkpoint cadence, fault
+    injection. Every process evaluates the same checkpoint condition at
+    the same chunk index, so snapshots are collective-consistent; injected
+    crashes fire here too, so a kill lands at one well-defined cut (the
+    supervisor then relaunches the fleet with --restore, Flink's
+    global-restart strategy)."""
+    _heartbeat(flags, job.pid)
     every = int(flags.get("checkpointEvery", "0"))
     root = flags.get("checkpointDir")
     if every > 0 and root and (chunk_idx + 1) % every == 0:
-        job.save_checkpoint(root, cursor)
-    fail_after = int(flags.get("failAfterChunks", "0"))
-    if fail_after and chunk_idx + 1 >= fail_after:
-        print(
-            f"[distributed p{job.pid}] injected failure after chunk "
-            f"{chunk_idx + 1}",
-            file=sys.stderr,
-            flush=True,
-        )
-        os._exit(3)
+        d = job.save_checkpoint(root, cursor)
+        injector.on_checkpoint(d)
+    injector.note_records(records)
+    injector.on_chunk(chunk_idx)
 
 
 def _sparse_tools(job: DistributedStreamJob):
@@ -1454,11 +1628,12 @@ def _drive_file_sparse(job: DistributedStreamJob, flags: Dict[str, str]) -> None
 
     resume = {"bytes": 0, "lines": 0}
     if _flag_true(flags, "restore") and flags.get("checkpointDir"):
-        cur = job.restore_checkpoint(flags["checkpointDir"])
+        cur = _restore_or_fresh(job, flags)
         if cur is not None:
             resume = dict(cur)
             job._warn(f"restored; resuming at {resume}")
     assert job.dim is not None, "no pipeline deployed and no snapshot found"
+    injector = _make_injector(job, flags)
     parser, vec = _sparse_tools(job)
     chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
     # size chunks in bytes from a crude per-line estimate; pump cadence
@@ -1477,9 +1652,10 @@ def _drive_file_sparse(job: DistributedStreamJob, flags: Dict[str, str]) -> None
         line_base += n
         consumed += stop
         job.pump()
-        _maybe_checkpoint_and_fail(
+        _chunk_tick(
             job, flags, chunk_idx,
             {"bytes": consumed, "lines": line_base},
+            injector, records=n,
         )
         chunk_idx += 1
     job.flush()
@@ -1494,11 +1670,12 @@ def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
 
     resume_cursor = 0
     if _flag_true(flags, "restore") and flags.get("checkpointDir"):
-        cur = job.restore_checkpoint(flags["checkpointDir"])
+        cur = _restore_or_fresh(job, flags)
         if cur is not None:
             resume_cursor = int(cur)
             job._warn(f"restored; resuming at row {resume_cursor}")
     assert job.dim is not None, "no pipeline deployed and no snapshot found"
+    injector = _make_injector(job, flags)
     cursor = 0
     chunk_idx = 0
     chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
@@ -1526,7 +1703,7 @@ def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         # synchronized pump point: every process sees the same chunk
         # sequence
         job.pump()
-        _maybe_checkpoint_and_fail(job, flags, chunk_idx, cursor)
+        _chunk_tick(job, flags, chunk_idx, cursor, injector, records=n)
         chunk_idx += 1
     job.flush()
 
@@ -1570,18 +1747,23 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
             req_offsets = dict(cur.get("requests", {}))
             job._warn(f"restored; resuming at offsets {offsets}")
 
+    injector = _make_injector(job, flags)
     consumer = KafkaConsumer(
         bootstrap_servers=brokers, consumer_timeout_ms=poll_ms
     )
 
     def _partitions(client, topic, retries=5):
-        for attempt in range(retries):
-            if attempt:
-                time.sleep(0.2 * attempt)
-            parts = client.partitions_for_topic(topic)
-            if parts:
-                return sorted(parts)
-        return []
+        # metadata fetch through the shared backoff helper (no hand-rolled
+        # sleep loops); [] after the budget keeps the degrade path
+        import dataclasses as _dc
+
+        from omldm_tpu.runtime.kafka_io import (
+            CONNECT_RETRY,
+            _partitions_with_retry,
+        )
+
+        policy = _dc.replace(CONNECT_RETRY, attempts=retries)
+        return sorted(_partitions_with_retry(client, topic, policy) or [])
 
     def _seek_or_resume(client, tp, saved_offsets):
         """Seek to the snapshot offset, else to the LOG START — recording
@@ -1786,9 +1968,10 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
                 _feed(topic, [tail])
         # 3. synchronized pump + checkpoint cadence
         job.pump()
-        _maybe_checkpoint_and_fail(
+        _chunk_tick(
             job, flags, chunk_idx,
             {"data": offsets, "requests": req_offsets},
+            injector, records=polled,
         )
         chunk_idx += 1
         # 4. agreed termination: stop after idleWindows globally-idle poll
@@ -1825,6 +2008,17 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
 
 
 def run_distributed(argv: Optional[List[str]] = None) -> int:
+    # --supervise: this process becomes the fleet supervisor instead of a
+    # worker — it spawns/monitors the N worker processes and applies the
+    # fixed-delay restart policy (it never initializes jax itself)
+    from omldm_tpu.__main__ import parse_flags as _parse_flags
+
+    pre_flags = _parse_flags(list(argv or []))
+    if _flag_true(pre_flags, "supervise"):
+        from omldm_tpu.runtime.supervisor import supervise_from_flags
+
+        return supervise_from_flags(pre_flags)
+
     # this environment's jax build pins its platform list at import and
     # IGNORES the JAX_PLATFORMS env var; honor it explicitly before any
     # backend/device initialization
@@ -1843,9 +2037,14 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
 
-    from omldm_tpu.__main__ import parse_flags
+    flags = pre_flags
+    # persistent XLA compile cache: restarted incarnations (and every
+    # process after the first on a shared cache) skip recompiling the
+    # collective programs — supervised recovery would otherwise pay tens
+    # of seconds of compile on each restart
+    from omldm_tpu.__main__ import _enable_compile_cache
 
-    flags = parse_flags(list(argv or []))
+    _enable_compile_cache(flags)
     if not flags.get("kafkaBrokers"):
         if "trainingData" not in flags:
             raise SystemExit("--trainingData is required in file mode")
@@ -1877,11 +2076,7 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         flags.get("checkpointDir")
     ) and os.path.exists(os.path.join(flags["checkpointDir"], "LATEST"))
     if not restoring:
-        lines: List[str] = []
-        if job.pid == 0 and flags.get("requests"):
-            with open(flags["requests"]) as f:
-                lines = [l.strip() for l in f if l.strip()]
-        job.sync_requests(lines)
+        _sync_requests_from_flags(job, flags)
     if flags.get("kafkaBrokers"):
         # a job may start with no pipelines: the Create can arrive on the
         # requests topic mid-run (startupIdleWindows bounds the wait)
@@ -1922,21 +2117,52 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
     # explicitly-passed file sink keeps precedence over the producer,
     # exactly the single-process CLI's rule (__main__._apply_kafka_sinks).
     sinks = None
+    # exactly-once-per-restart output dedupe: a process that already
+    # published its topic outputs (then died before exiting cleanly)
+    # leaves an EMITTED marker next to the checkpoints; the restored
+    # incarnation honors it instead of double-publishing. File sinks need
+    # no marker — they truncate-rewrite, so restarts self-dedupe.
+    marker = None
+    if flags.get("checkpointDir"):
+        marker = os.path.join(flags["checkpointDir"], f"EMITTED.p{job.pid}")
+        if not restoring:
+            try:
+                os.unlink(marker)  # stale marker from an earlier job
+            except OSError:
+                pass
+    already_emitted = marker is not None and os.path.exists(marker)
     if flags.get("kafkaBrokers"):
         try:
             from kafka import KafkaProducer
 
-            from omldm_tpu.runtime.kafka_io import ProducerSinks
+            from omldm_tpu.runtime.kafka_io import (
+                CONNECT_RETRY,
+                ProducerSinks,
+            )
+            from omldm_tpu.utils.backoff import with_backoff
 
             sinks = ProducerSinks(
-                KafkaProducer(bootstrap_servers=flags["kafkaBrokers"])
+                with_backoff(
+                    lambda: KafkaProducer(
+                        bootstrap_servers=flags["kafkaBrokers"]
+                    ),
+                    retry_on=(Exception,),
+                    policy=CONNECT_RETRY,
+                )
             )
         except Exception as exc:
             # broker gone at shutdown must not lose the file outputs
             job._warn(f"output-topic producer unavailable: {exc}")
             sinks = None
+    if already_emitted and sinks is not None:
+        job._warn(
+            "outputs already published to the topics by a previous "
+            "incarnation; skipping topic publication (exactly-once)"
+        )
     want_preds_file = bool(flags.get("predictionsOut"))
-    publish_preds = sinks is not None and not want_preds_file
+    publish_preds = (
+        sinks is not None and not want_preds_file and not already_emitted
+    )
     if want_preds_file or publish_preds:
         payloads = [
             {"mlpId": net_id, "value": v}
@@ -1962,15 +2188,27 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
             with open(flags["responsesOut"], "w") as f:
                 for resp in job.responses:
                     f.write(resp.to_json() + "\n")
-        elif sinks is not None:
+        elif sinks is not None and not already_emitted:
             for resp in job.responses:
                 sinks.on_response(resp)
         if flags.get("performanceOut"):
             with open(flags["performanceOut"], "w") as f:
                 f.write(json.dumps(report) + "\n")
-        elif sinks is not None:
+        elif sinks is not None and not already_emitted:
             sinks.on_performance(report)
         print(json.dumps(report))
+    if (
+        marker is not None
+        and sinks is not None
+        and not already_emitted
+        and not sinks.dropped
+    ):
+        # published (or deliberately skipped for file sinks): a crash
+        # between here and exit must not republish on the next restore.
+        # NOT written when the degraded producer dropped sends — those
+        # outputs were never delivered, so a restored incarnation against
+        # a healed broker must still publish them
+        _atomic_write_bytes(marker, b"published\n")
     if sinks is not None:
         sinks.close()
     return 0

@@ -17,6 +17,7 @@ this image, hence the gate.
 from __future__ import annotations
 
 import json
+import sys
 from typing import Any, Iterator, Mapping, Optional, Tuple
 
 from omldm_tpu.runtime.job import (
@@ -24,6 +25,15 @@ from omldm_tpu.runtime.job import (
     REQUEST_STREAM,
     TRAINING_STREAM,
 )
+from omldm_tpu.utils.backoff import BackoffPolicy, with_backoff
+
+# connect-time metadata / client-construction retries: a fresh client can
+# transiently miss partition metadata, and a broker mid-restart refuses
+# connections for a few seconds — both recover under short backoff
+CONNECT_RETRY = BackoffPolicy(attempts=5, base_delay=0.2, growth=1.5, jitter=0.05)
+# producer sends are on the streaming hot path: retry briefly, then the
+# sink DEGRADES (warn + drop) instead of raising out of the pump loop
+SEND_RETRY = BackoffPolicy(attempts=3, base_delay=0.05, jitter=0.02)
 
 # topic-name defaults mirroring the reference (README.md:21-26)
 DEFAULT_TOPICS = {
@@ -111,27 +121,85 @@ class ProducerSinks:
     shape). Returns the three callbacks StreamJob accepts. ``consumer``,
     when provided, is owned too: :meth:`close` shuts both down (used by
     supervised recovery before rebuilding the clients, so restarts do not
-    leak broker connections)."""
+    leak broker connections).
+
+    Failure semantics: each send retries under ``retry`` (short backoff);
+    a send that still fails DEGRADES — the record is dropped with a
+    warning instead of raising out of the streaming pump loop, so a broker
+    that dies mid-run downgrades topic publication to warnings while the
+    job (and any file sinks) keeps flowing. Drops are counted in
+    ``dropped`` and summarized at :meth:`close`. This is the sink half of
+    the reference's posture: the Flink job's Kafka producers buffer and
+    fail asynchronously rather than crashing the operator chain."""
+
+    # warn for the first few drops per topic, then thin the log
+    _WARN_FIRST = 3
+    _WARN_EVERY = 100
+    # consecutive exhausted sends before the breaker trips: a dead broker
+    # must not charge every remaining record the full retry backoff on the
+    # streaming hot path — trip, drop with ONE cheap probe per record (so
+    # a healed broker closes the breaker again), no sleeping
+    _BREAKER_AFTER = 5
 
     def __init__(
         self,
         producer: Any,
         out_topics: Optional[Mapping[str, str]] = None,
         consumer: Any = None,
+        retry: Optional[BackoffPolicy] = None,
     ):
         self.producer = producer
         self.consumer = consumer
         self.topics = dict(out_topics or DEFAULT_OUT_TOPICS)
+        self.retry = retry or SEND_RETRY
+        self.dropped = 0
+        self._drops_by_topic: dict = {}
+        self._consecutive_failures = 0
 
     def close(self) -> None:
+        if self.dropped:
+            print(
+                f"warning: {self.dropped} output record(s) dropped by "
+                f"unreachable producer (per topic: {self._drops_by_topic})",
+                file=sys.stderr,
+            )
         for client in (self.consumer, self.producer):
             close = getattr(client, "close", None)
             if close is not None:
-                close()
+                try:
+                    close()
+                except Exception as exc:  # a dead client must not mask shutdown
+                    print(
+                        f"warning: producer/consumer close failed: {exc}",
+                        file=sys.stderr,
+                    )
 
     def _send(self, topic_key: str, obj: Any) -> None:
         payload = obj.to_json() if hasattr(obj, "to_json") else json.dumps(obj)
-        self.producer.send(self.topics[topic_key], payload.encode())
+        topic = self.topics[topic_key]
+        tripped = self._consecutive_failures >= self._BREAKER_AFTER
+        try:
+            if tripped:  # breaker open: one probe, no retries, no sleep
+                self.producer.send(topic, payload.encode())
+            else:
+                with_backoff(
+                    lambda: self.producer.send(topic, payload.encode()),
+                    retry_on=(Exception,),
+                    policy=self.retry,
+                )
+            self._consecutive_failures = 0
+        except Exception as exc:
+            self._consecutive_failures += 1
+            self.dropped += 1
+            n = self._drops_by_topic.get(topic, 0) + 1
+            self._drops_by_topic[topic] = n
+            if n <= self._WARN_FIRST or n % self._WARN_EVERY == 0:
+                print(
+                    f"warning: dropping record for topic {topic!r} "
+                    f"(send failed {n}x: {type(exc).__name__}: {exc}); "
+                    "continuing without topic publication",
+                    file=sys.stderr,
+                )
 
     def on_prediction(self, pred) -> None:
         self._send("predictions", pred)
@@ -143,6 +211,17 @@ class ProducerSinks:
         self._send("performance", report)
 
 
+def _partitions_with_retry(consumer, topic, retry: Optional[BackoffPolicy] = None):
+    """partitions_for_topic can transiently return None on a fresh client
+    (metadata not fetched yet) — retry with backoff, ``None`` after the
+    budget (callers keep their degrade paths)."""
+    return with_backoff(
+        lambda: consumer.partitions_for_topic(topic),
+        accept=bool,
+        policy=retry or CONNECT_RETRY,
+    ) or None
+
+
 def connect_kafka(
     brokers: str,
     topic_map: Optional[Mapping[str, str]] = None,
@@ -150,6 +229,8 @@ def connect_kafka(
     poll_timeout_ms: int = 1000,
     position: Optional[Mapping[Tuple[str, int], int]] = None,
     tracker: Optional[dict] = None,
+    retry: Optional[BackoffPolicy] = None,
+    send_retry: Optional[BackoffPolicy] = None,
 ) -> Tuple[Iterator[Optional[Tuple[str, str]]], "ProducerSinks"]:
     """Wire real Kafka clients. Requires kafka-python or confluent_kafka;
     raises ImportError with guidance otherwise (neither library ships in
@@ -182,25 +263,23 @@ def connect_kafka(
             "file replay or in-memory events."
         ) from e
     topic_map = dict(topic_map or DEFAULT_TOPICS)
+    retry = retry or CONNECT_RETRY
 
-    def _partitions_with_retry(consumer, topic):
-        # partitions_for_topic can transiently return None on a fresh
-        # client (metadata not fetched yet) — retry with backoff
-        import time as _time
-
-        for attempt in range(5):
-            if attempt:  # back off BEFORE each retry, not after the last
-                _time.sleep(0.2 * attempt)
-            parts = consumer.partitions_for_topic(topic)
-            if parts:
-                return parts
-        return None
+    def _client(ctor, *args, **kw):
+        # broker mid-restart: client CONSTRUCTION (bootstrap metadata)
+        # retries under the same policy as partition metadata
+        return with_backoff(
+            lambda: ctor(*args, **kw),
+            retry_on=(Exception,),
+            policy=retry,
+        )
 
     # consumer_timeout_ms bounds each poll so the iterator goes idle (raises
     # StopIteration, resumable) instead of blocking forever — required for
     # the silence-timer termination to ever fire on a quiet broker
     if position is not None:
-        consumer = KafkaConsumer(
+        consumer = _client(
+            KafkaConsumer,
             bootstrap_servers=brokers,
             consumer_timeout_ms=poll_timeout_ms,
         )
@@ -211,7 +290,7 @@ def connect_kafka(
         # multi-partition topic would lose data
         assigned = []
         for topic in topic_map:
-            parts = _partitions_with_retry(consumer, topic)
+            parts = _partitions_with_retry(consumer, topic, retry)
             if not parts:
                 parts = {
                     p for (t, p) in position if t == topic
@@ -268,7 +347,8 @@ def connect_kafka(
                 except Exception:
                     pass  # best-effort, like the initial-connect seeding
     else:
-        consumer = KafkaConsumer(
+        consumer = _client(
+            KafkaConsumer,
             *topic_map.keys(),
             bootstrap_servers=brokers,
             consumer_timeout_ms=poll_timeout_ms,
@@ -301,8 +381,10 @@ def connect_kafka(
                     continue  # seeding is best-effort, never fatal
                 for tp, off in ends.items():
                     tracker.setdefault((tp.topic, tp.partition), off)
-    producer = KafkaProducer(bootstrap_servers=brokers)
+    producer = _client(KafkaProducer, bootstrap_servers=brokers)
     return (
         polling_events(consumer, topic_map, tracker=tracker),
-        ProducerSinks(producer, out_topics, consumer=consumer),
+        ProducerSinks(
+            producer, out_topics, consumer=consumer, retry=send_retry
+        ),
     )

@@ -119,11 +119,13 @@ class JobSupervisor:
         max_restarts: int = 3,
         restart_delay_s: float = 0.0,
         on_failure: Optional[Callable[[FailureRecord], None]] = None,
+        restart_jitter_s: float = 0.0,
     ):
         self.job = job
         self.source_factory = source_factory
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
+        self.restart_jitter_s = restart_jitter_s
         self.on_failure = on_failure
         self.failures: List[FailureRecord] = []
         # only checkpoints taken DURING this supervised run are restore
@@ -136,7 +138,9 @@ class JobSupervisor:
         )
 
     def run(self, terminate_on_end: bool = True) -> Optional[JobStatistics]:
-        while True:
+        from omldm_tpu.utils.backoff import with_backoff
+
+        def attempt() -> Optional[JobStatistics]:
             job = self.job
             try:
                 return job.run(
@@ -144,19 +148,31 @@ class JobSupervisor:
                     terminate_on_end=terminate_on_end,
                 )
             except Exception as exc:  # any escape is a detected job failure
-                record = FailureRecord(
+                self.failures.append(FailureRecord(
                     offset=job.events_processed,
                     error=f"{type(exc).__name__}: {exc}",
                     at=time.time(),
-                )
-                self.failures.append(record)
-                if len(self.failures) > self.max_restarts:
-                    raise
-                if self.restart_delay_s > 0:
-                    time.sleep(self.restart_delay_s)
-                self.job = self._recover(job, record)
-                if self.on_failure is not None:
-                    self.on_failure(record)
+                ))
+                raise
+
+        def on_retry(exc: Exception, next_attempt: int) -> None:
+            record = self.failures[-1]
+            self.job = self._recover(self.job, record)
+            if self.on_failure is not None:
+                self.on_failure(record)
+
+        # Flink's fixed-delay restart strategy through the one shared
+        # backoff implementation: max_restarts retries at a constant delay
+        # (+ optional jitter so a fleet of supervised jobs desynchronizes)
+        return with_backoff(
+            attempt,
+            attempts=self.max_restarts + 1,
+            base_delay=self.restart_delay_s,
+            growth=1.0,
+            jitter=self.restart_jitter_s,
+            retry_on=(Exception,),
+            on_retry=on_retry,
+        )
 
     def _recover(self, failed: StreamJob, record: FailureRecord) -> StreamJob:
         """Build the next incarnation: restore the latest checkpoint when

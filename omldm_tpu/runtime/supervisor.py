@@ -1,0 +1,507 @@
+"""Distributed fault tolerance: supervised recovery for the multi-process job.
+
+Reference counterpart: the Flink substrate gives the reference job cluster
+fault tolerance for free — the JobManager detects TaskManager death or
+heartbeat loss, applies the configured restart strategy
+(``RestartStrategies.fixedDelayRestart(attempts, delay)``, Job.scala:14),
+restores every operator from the latest completed checkpoint, and rewinds
+the Kafka sources to the checkpointed offsets. The single-process path
+reproduces that in-process (:class:`~omldm_tpu.runtime.recovery.JobSupervisor`);
+this module is the MULTI-PROCESS form, for the flagship
+:class:`~omldm_tpu.runtime.distributed_job.DistributedStreamJob`:
+
+- :class:`DistributedJobSupervisor` launches the N worker processes of a
+  distributed job, watches them through two health channels — process exit
+  codes and a heartbeat file each worker touches at every synchronized
+  pump point (the role of Flink's TaskManager heartbeat; a worker wedged
+  inside a collective whose peer died stops beating and is detected even
+  though it never exits) — and on any failure kills the whole fleet and
+  relaunches it with ``--restore true`` under a fixed-delay restart policy
+  (bounded attempts, optional jitter), routed through the shared
+  :func:`~omldm_tpu.utils.backoff.with_backoff` helper. A relaunch
+  restores the latest CONSISTENT distributed checkpoint (corrupt shards
+  fall back to the previous complete snapshot — see
+  ``DistributedStreamJob.restore_checkpoint``) and replays the source from
+  the checkpoint floor: the file cursor for strided file partitions,
+  per-partition offsets for Kafka. Crash-before-first-checkpoint restarts
+  fresh from offset 0 — Flink's behavior for an uncheckpointed job.
+- :class:`DistributedFaultInjector` is the cluster-shape fault-injection
+  half: flag-driven (the faults must fire inside REAL worker processes),
+  it can kill a CHOSEN process after N ingested records, corrupt or
+  withhold a checkpoint shard after a chosen snapshot commits, and sever
+  the (file-backed) Kafka broker mid-stream — so every recovery path is
+  exercised by tests rather than claimed.
+
+Output dedupe: final outputs (predictions / responses / performance) are
+emitted once per SUCCESSFUL incarnation. File sinks are truncate-rewritten
+so restarts self-dedupe; topic publication is guarded by per-process
+``EMITTED.p<i>`` markers in the checkpoint directory (written after a
+process publishes, honored on restore) so a crash between publication and
+exit does not double-publish — exactly-once per restart for the sinks the
+reference treats as at-least-once.
+
+CLI: one command supervises the whole fleet (vs. launching each process by
+hand)::
+
+    python -m omldm_tpu --supervise --processes 2 \\
+        --requests reqs.jsonl --trainingData train.jsonl \\
+        --checkpointDir /ckpts --checkpointEvery 50 \\
+        --restartAttempts 3 --restartDelayMs 1000 --heartbeatTimeoutMs 60000
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence
+
+from omldm_tpu.utils.backoff import with_backoff
+
+# flags the supervisor consumes itself; everything else passes through to
+# the workers verbatim
+SUPERVISOR_ONLY_FLAGS = {
+    "supervise",
+    "restartAttempts",
+    "restartDelayMs",
+    "restartJitterMs",
+    "heartbeatTimeoutMs",
+    "workerBoot",
+    "supervisorDir",
+}
+
+
+class FleetFailure(RuntimeError):
+    """One failed attempt of the supervised fleet (cause + exit code)."""
+
+    def __init__(self, cause: str, returncode: int, failed: Sequence[int]):
+        super().__init__(cause)
+        self.cause = cause
+        self.returncode = returncode
+        self.failed = list(failed)
+
+
+@dataclasses.dataclass
+class AttemptRecord:
+    """One detected fleet failure (the supervisor's incident log)."""
+
+    attempt: int  # 1-based attempt index that failed
+    cause: str  # "process 1 exited 3" | "heartbeat timeout on process 0"
+    failed: List[int]  # process ids implicated
+    at: float
+    restored: bool  # whether a checkpoint existed to restore from
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class DistributedJobSupervisor:
+    """Run the N-process distributed job under a fixed-delay restart policy.
+
+    ``worker_args`` is the job's flag list WITHOUT the per-process plumbing
+    (``--processes/--processId/--coordinator/--restore`` are added per
+    attempt; a fresh coordinator port is drawn each time so a dying
+    fleet's socket never blocks its successor). ``worker_cmd`` overrides
+    the interpreter command prefix (default ``python -m
+    omldm_tpu.runtime.distributed_job``) — tests use it to bootstrap the
+    file-backed Kafka fake inside real subprocesses.
+
+    Restart policy: ``max_restarts`` relaunches at ``restart_delay_s``
+    fixed delay (+ jitter), mirroring Flink's fixedDelayRestart. Restarts
+    pass ``--restore true``: with a ``--checkpointDir`` in ``worker_args``
+    the fleet resumes from the latest consistent snapshot and replays the
+    source from the checkpoint floor; without one (or before the first
+    snapshot) the relaunch is a fresh run from offset 0.
+
+    Health channels: a worker process exiting nonzero fails the attempt
+    immediately. With ``heartbeat_timeout_s > 0`` the supervisor also
+    passes each worker ``--heartbeatDir`` and fails the attempt when a
+    live worker's beat goes stale — the collective-timeout detector (a
+    worker blocked in a fabric collective whose peer died may never exit
+    on its own). The clock for a worker starts at its spawn, so slow
+    first-compile startups need a timeout above their compile time.
+    """
+
+    def __init__(
+        self,
+        worker_args: Sequence[str],
+        num_processes: int,
+        *,
+        max_restarts: int = 3,
+        restart_delay_s: float = 0.0,
+        restart_jitter_s: float = 0.0,
+        heartbeat_timeout_s: float = 0.0,
+        worker_cmd: Optional[Sequence[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        run_dir: Optional[str] = None,
+        poll_interval_s: float = 0.05,
+    ):
+        if num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+        self.worker_args = list(worker_args)
+        self.nproc = num_processes
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.restart_jitter_s = restart_jitter_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.worker_cmd = list(
+            worker_cmd
+            or [sys.executable, "-m", "omldm_tpu.runtime.distributed_job"]
+        )
+        self.env = env
+        self.cwd = cwd
+        self.poll_interval_s = poll_interval_s
+        self._own_run_dir = run_dir is None
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix="omldm-supervise-")
+        self.hb_dir = os.path.join(self.run_dir, "heartbeats")
+        self.failures: List[AttemptRecord] = []
+
+    def _log(self, msg: str) -> None:
+        print(f"[supervisor] {msg}", file=sys.stderr, flush=True)
+
+    # --- one attempt -------------------------------------------------------
+
+    def _worker_argv(self, pid: int, port: int, restore: bool) -> List[str]:
+        args = list(self.worker_cmd) + list(self.worker_args)
+        args += ["--processes", str(self.nproc), "--processId", str(pid)]
+        if self.nproc > 1:
+            args += ["--coordinator", f"127.0.0.1:{port}"]
+        if restore:
+            args += ["--restore", "true"]
+        if self.heartbeat_timeout_s > 0:
+            args += ["--heartbeatDir", self.hb_dir]
+        return args
+
+    def _beat_age(self, pid: int, spawned_at: float, now: float) -> float:
+        # wall-clock throughout: beat files only expose epoch mtimes
+        try:
+            return now - os.path.getmtime(
+                os.path.join(self.hb_dir, f"proc{pid}.hb")
+            )
+        except OSError:
+            return now - spawned_at  # no beat yet: clock runs from spawn
+
+    def _kill_fleet(self, procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait()
+
+    def _run_attempt(self, restore: bool) -> None:
+        """Spawn the fleet and block until success (all exit 0) or a
+        detected failure (raises :class:`FleetFailure`)."""
+        if self.heartbeat_timeout_s > 0:
+            shutil.rmtree(self.hb_dir, ignore_errors=True)
+            os.makedirs(self.hb_dir, exist_ok=True)
+        port = _free_port()
+        spawned_at = time.time()
+        procs = [
+            subprocess.Popen(
+                self._worker_argv(pid, port, restore),
+                env=self.env,
+                cwd=self.cwd,
+            )
+            for pid in range(self.nproc)
+        ]
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [i for i, rc in enumerate(codes) if rc not in (None, 0)]
+                if bad:
+                    raise FleetFailure(
+                        "process "
+                        + ", ".join(f"{i} exited {codes[i]}" for i in bad),
+                        returncode=codes[bad[0]],
+                        failed=bad,
+                    )
+                if all(rc == 0 for rc in codes):
+                    return
+                if self.heartbeat_timeout_s > 0:
+                    now = time.time()
+                    stale = [
+                        i
+                        for i, rc in enumerate(codes)
+                        if rc is None
+                        and self._beat_age(i, spawned_at, now)
+                        > self.heartbeat_timeout_s
+                    ]
+                    if stale:
+                        raise FleetFailure(
+                            "heartbeat timeout on process "
+                            + ", ".join(map(str, stale)),
+                            returncode=1,
+                            failed=stale,
+                        )
+                time.sleep(self.poll_interval_s)
+        finally:
+            self._kill_fleet(procs)
+
+    # --- the restart policy ------------------------------------------------
+
+    def _checkpoint_exists(self) -> bool:
+        root = None
+        for i, arg in enumerate(self.worker_args):
+            if arg == "--checkpointDir" and i + 1 < len(self.worker_args):
+                root = self.worker_args[i + 1]
+        return bool(root) and os.path.exists(os.path.join(root, "LATEST"))
+
+    def run(self) -> int:
+        """Supervise to completion. Returns 0 on success; raises the last
+        :class:`FleetFailure` once ``max_restarts`` is exhausted."""
+        state = {"first": True}
+
+        def attempt() -> int:
+            restore = not state["first"]
+            state["first"] = False
+            if restore:
+                self._log(
+                    "relaunching fleet"
+                    + (
+                        " from latest consistent checkpoint"
+                        if self._checkpoint_exists()
+                        else " fresh (no checkpoint taken before the failure)"
+                    )
+                )
+            self._run_attempt(restore=restore)
+            return 0
+
+        def on_retry(exc: Exception, next_attempt: int) -> None:
+            record = AttemptRecord(
+                attempt=next_attempt - 1,
+                cause=str(exc),
+                failed=getattr(exc, "failed", []),
+                at=time.time(),
+                restored=self._checkpoint_exists(),
+            )
+            self.failures.append(record)
+            self._log(
+                f"fleet failure ({record.cause}); restart "
+                f"{record.attempt}/{self.max_restarts}"
+            )
+
+        try:
+            return with_backoff(
+                attempt,
+                attempts=self.max_restarts + 1,
+                base_delay=self.restart_delay_s,
+                growth=1.0,  # Flink's fixed-delay restart strategy
+                jitter=self.restart_jitter_s,
+                retry_on=(FleetFailure,),
+                on_retry=on_retry,
+            )
+        except FleetFailure as exc:
+            # the terminal failure is an incident too (parity with the
+            # single-process supervisor's failure log)
+            self.failures.append(
+                AttemptRecord(
+                    attempt=len(self.failures) + 1,
+                    cause=exc.cause,
+                    failed=exc.failed,
+                    at=time.time(),
+                    restored=self._checkpoint_exists(),
+                )
+            )
+            self._log(
+                f"giving up after {len(self.failures)} failed attempt(s): "
+                f"{exc.cause}"
+            )
+            raise
+        finally:
+            if self._own_run_dir:
+                shutil.rmtree(self.run_dir, ignore_errors=True)
+
+
+def supervise_from_flags(flags: Dict[str, str]) -> int:
+    """CLI adapter: ``--supervise`` turns the launcher process into the
+    fleet supervisor (it never imports jax or touches the fabric). All
+    non-supervisor flags pass through to every worker. Returns the exit
+    code for the CLI; exhausted restarts exit with the last worker's code."""
+    nproc = int(flags.get("processes", "1"))
+    worker_args: List[str] = []
+    for key, value in flags.items():
+        if key in SUPERVISOR_ONLY_FLAGS or key in (
+            "processes",
+            "processId",
+            "coordinator",
+            "restore",
+        ):
+            continue
+        worker_args += [f"--{key}", value]
+    worker_cmd = None
+    if flags.get("workerBoot"):
+        # bootstrap code for the worker interpreters (tests install the
+        # file-backed kafka fake before production imports resolve)
+        worker_cmd = [sys.executable, "-c", flags["workerBoot"]]
+    sup = DistributedJobSupervisor(
+        worker_args,
+        nproc,
+        max_restarts=int(flags.get("restartAttempts", "3")),
+        restart_delay_s=float(flags.get("restartDelayMs", "0")) / 1000.0,
+        restart_jitter_s=float(flags.get("restartJitterMs", "0")) / 1000.0,
+        heartbeat_timeout_s=float(flags.get("heartbeatTimeoutMs", "0"))
+        / 1000.0,
+        worker_cmd=worker_cmd,
+        run_dir=flags.get("supervisorDir"),
+    )
+    try:
+        return sup.run()
+    except FleetFailure as exc:
+        return exc.returncode or 1
+
+
+class DistributedFaultInjector:
+    """Flag-driven deterministic fault injection for the multi-process job.
+
+    The single-process :class:`~omldm_tpu.runtime.recovery.FaultInjector`
+    monkeypatches spokes in-process; the cluster shape needs faults that
+    fire inside REAL worker processes, so this one is armed from CLI flags
+    and driven by the drive loops at synchronized pump points:
+
+    - ``--failProcess p --failAfterRecords N``: process ``p`` hard-exits
+      (code 3) at the first pump point after ingesting >= N records — the
+      chosen-worker crash (a lost TaskManager).
+    - ``--failAfterChunks k``: EVERY process exits after chunk ``k`` (the
+      whole-deployment cut used by the checkpoint-resume tests).
+    - ``--corruptShardProcess p --corruptShardSeq k`` (+
+      ``--corruptShardMode truncate|withhold``): after checkpoint ``k``
+      commits, process ``p`` truncates (or deletes) its own proc shard in
+      that snapshot — the torn-write/lost-file disk fault that restore
+      must survive by falling back to the previous complete snapshot.
+    - ``--severBrokerAfterChunks k``: process 0 severs the file-backed
+      Kafka broker (renames the ``FSKAFKA_DIR`` directory) mid-stream —
+      consumers go permanently idle, producer (re)connects fail; the job
+      must degrade to warnings + file sinks, not crash.
+
+    All triggers are one-shot and deterministic given a fixed chunk size.
+    """
+
+    EXIT_CODE = 3
+
+    def __init__(self, flags: Dict[str, str], pid: int):
+        self.pid = pid
+        self.fail_process = int(flags.get("failProcess", "-1"))
+        self.fail_after_records = int(flags.get("failAfterRecords", "0"))
+        self.fail_after_chunks = int(flags.get("failAfterChunks", "0"))
+        self.corrupt_process = int(flags.get("corruptShardProcess", "-1"))
+        self.corrupt_seq = int(flags.get("corruptShardSeq", "-1"))
+        self.corrupt_mode = flags.get("corruptShardMode", "truncate")
+        self.sever_after_chunks = int(flags.get("severBrokerAfterChunks", "0"))
+        self.records_seen = 0
+        self._severed = False
+
+    def note_records(self, n: int) -> None:
+        """Count records this process's ingest moved past a pump point."""
+        self.records_seen += int(n)
+
+    def _die(self, why: str) -> None:
+        print(
+            f"[fault-injector p{self.pid}] injected crash: {why}",
+            file=sys.stderr,
+            flush=True,
+        )
+        # hard exit, like a SIGKILLed/OOMed worker: no atexit, no flush of
+        # in-flight state — the supervisor must recover from the disk truth
+        os._exit(self.EXIT_CODE)
+
+    def on_chunk(self, chunk_idx: int) -> None:
+        """Called at every synchronized pump point (after the checkpoint
+        cadence ran for this chunk)."""
+        if self.fail_after_chunks and chunk_idx + 1 >= self.fail_after_chunks:
+            self._die(f"after chunk {chunk_idx + 1} (all processes)")
+        if (
+            self.fail_process == self.pid
+            and self.fail_after_records > 0
+            and self.records_seen >= self.fail_after_records
+        ):
+            self._die(
+                f"worker {self.pid} after {self.records_seen} records"
+            )
+        if (
+            self.sever_after_chunks
+            and chunk_idx + 1 >= self.sever_after_chunks
+            and not self._severed
+            and self.pid == 0
+        ):
+            self._severed = True
+            self._sever_broker()
+
+    def on_checkpoint(self, ckpt_dir: str) -> None:
+        """Called after a distributed snapshot commits (post-barrier)."""
+        if self.corrupt_process != self.pid or self.corrupt_seq < 0:
+            return
+        try:
+            seq = int(os.path.basename(ckpt_dir).split("-", 1)[1])
+        except (IndexError, ValueError):
+            return
+        if seq != self.corrupt_seq:
+            return
+        shard = os.path.join(ckpt_dir, f"proc{self.pid}.npz")
+        self.corrupt_seq = -1  # one-shot
+        if self.corrupt_mode == "withhold":
+            os.unlink(shard)
+            verb = "withheld"
+        else:
+            size = os.path.getsize(shard)
+            with open(shard, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+            verb = f"truncated to {max(size // 2, 1)}B"
+        print(
+            f"[fault-injector p{self.pid}] {verb} checkpoint shard {shard}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def _sever_broker(self) -> None:
+        broker = os.environ.get("FSKAFKA_DIR")
+        if broker and os.path.isdir(broker):
+            os.rename(broker, broker + ".severed")
+            # leave a plain FILE at the broker path: consumers list no
+            # partitions (permanently idle) and producer appends raise —
+            # a dead broker, not a fresh empty one the next send recreates
+            with open(broker, "w"):
+                pass
+            print(
+                f"[fault-injector p{self.pid}] severed file-backed broker "
+                f"{broker}",
+                file=sys.stderr,
+                flush=True,
+            )
+        else:
+            print(
+                f"[fault-injector p{self.pid}] severBroker requested but no "
+                "file-backed broker to sever (FSKAFKA_DIR unset)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+
+__all__ = [
+    "AttemptRecord",
+    "DistributedFaultInjector",
+    "DistributedJobSupervisor",
+    "FleetFailure",
+    "supervise_from_flags",
+]

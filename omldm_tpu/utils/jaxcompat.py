@@ -1,0 +1,56 @@
+"""Version portability for the handful of new jax APIs this codebase uses.
+
+The SPMD engine (and everything stacked on it: the SPMD bridge, the
+multi-process ``DistributedStreamJob``, the supervised-recovery drills) is
+written against the current jax surface — ``jax.shard_map``,
+``jax.lax.pcast`` — but deployment images pin older releases where those
+live under ``jax.experimental.shard_map`` / don't exist yet. A production
+system must run on the jax the image ships, so the engine routes these
+three symbols through here instead of hard-binding to one release:
+
+- :func:`shard_map`: ``jax.shard_map`` when present, else the
+  ``jax.experimental.shard_map`` implementation with the ``check_vma``
+  knob mapped away (older releases call the equivalent ``check_rep``;
+  replication checking there rejects the invariant->varying casts newer
+  code expresses with pvary, so it is disabled).
+- :func:`pvary`: invariant -> varying cast; ``jax.lax.pcast`` (newest) ->
+  ``jax.lax.pvary`` (deprecated spelling) -> identity (pre-vma releases
+  track nothing, the cast is a no-op).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f=None, **kwargs):
+    """Portable ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...)``."""
+    if f is None:  # partial form: shard_map(mesh=..., ...)(f)
+        return lambda g: shard_map(g, **kwargs)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kwargs.pop("check_vma", None)
+    kwargs.setdefault("check_rep", False)
+    return _sm(f, **kwargs)
+
+
+def pvary(x, axes):
+    """Invariant -> varying cast across ``axes`` (no-op data movement)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x  # pre-vma jax: no varying-axis typing to satisfy
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped axis inside shard_map.
+    ``jax.lax.axis_size`` when present; on older releases the axis env
+    answers directly (``core.axis_frame(name)`` returns the size)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax._src import core as _core
+
+    return int(_core.axis_frame(axis_name))

@@ -1,0 +1,183 @@
+"""Direct unit tests of the shared retry/backoff helper (no real sleeping).
+
+This is the single implementation behind every retry loop in the framework
+(Kafka metadata fetches, producer sends, the job supervisors' fixed-delay
+restart policies) — its semantics are pinned here so the call sites can
+stay thin."""
+
+import pytest
+
+from omldm_tpu.utils.backoff import BackoffPolicy, with_backoff
+
+
+class Clock:
+    """Deterministic sleep/clock pair: sleeping advances the clock."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+    def clock(self):
+        return self.now
+
+
+def test_success_first_try_no_sleep():
+    clk = Clock()
+    calls = []
+    out = with_backoff(
+        lambda: calls.append(1) or "ok", attempts=5, sleep=clk.sleep
+    )
+    assert out == "ok"
+    assert len(calls) == 1
+    assert clk.sleeps == []
+
+
+def test_retries_on_listed_exception_then_succeeds():
+    clk = Clock()
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError("transient")
+        return state["n"]
+
+    out = with_backoff(
+        flaky, attempts=5, base_delay=0.2, retry_on=(ConnectionError,),
+        sleep=clk.sleep,
+    )
+    assert out == 3
+    assert clk.sleeps == [0.2, 0.2]  # fixed delay (growth=1.0, Flink-style)
+
+
+def test_unlisted_exception_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError, match="not transient"):
+        with_backoff(boom, attempts=5, retry_on=(ConnectionError,),
+                     sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_exhausted_attempts_reraise_last_exception():
+    clk = Clock()
+    with pytest.raises(ConnectionError):
+        with_backoff(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")),
+            attempts=3, base_delay=0.1, retry_on=(ConnectionError,),
+            sleep=clk.sleep,
+        )
+    assert len(clk.sleeps) == 2  # no sleep after the final attempt
+
+
+def test_accept_predicate_retries_on_rejected_result():
+    clk = Clock()
+    results = iter([None, None, {1, 2}])
+    out = with_backoff(
+        lambda: next(results), attempts=5, base_delay=0.2, accept=bool,
+        sleep=clk.sleep,
+    )
+    assert out == {1, 2}
+    assert len(clk.sleeps) == 2
+
+
+def test_exhausted_accept_returns_last_result():
+    """Callers keep their degrade paths: an unaccepted final result comes
+    back as-is instead of raising (partitions_for_topic -> None)."""
+    out = with_backoff(
+        lambda: None, attempts=3, base_delay=0.0, accept=bool,
+        sleep=lambda s: None,
+    )
+    assert out is None
+
+
+def test_growth_and_jitter_schedule():
+    clk = Clock()
+    with pytest.raises(ConnectionError):
+        with_backoff(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")),
+            attempts=4, base_delay=0.1, growth=2.0, jitter=0.05,
+            retry_on=(ConnectionError,), sleep=clk.sleep, rng=lambda: 0.5,
+        )
+    # delays 0.1*2^0, 0.1*2^1, 0.1*2^2, each + 0.5*jitter
+    assert clk.sleeps == pytest.approx([0.125, 0.225, 0.425])
+
+
+def test_timeout_deadline_stops_retrying():
+    clk = Clock()
+    calls = []
+
+    def failing():
+        calls.append(clk.now)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        with_backoff(
+            failing, attempts=100, base_delay=1.0, timeout=2.5,
+            retry_on=(ConnectionError,), sleep=clk.sleep, clock=clk.clock,
+        )
+    # attempts at t=0, 1, 2; the deadline (2.5) then blocks further retries
+    assert len(calls) == 3
+
+
+def test_on_retry_hook_sees_cause_and_next_attempt():
+    seen = []
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ConnectionError("first")
+        return "ok"
+
+    out = with_backoff(
+        flaky, attempts=3, base_delay=0.0, retry_on=(ConnectionError,),
+        on_retry=lambda exc, k: seen.append((type(exc).__name__, k)),
+        sleep=lambda s: None,
+    )
+    assert out == "ok"
+    assert seen == [("ConnectionError", 2)]
+
+
+def test_on_retry_hook_none_exc_for_rejected_result():
+    seen = []
+    results = iter([None, "ok"])
+    with_backoff(
+        lambda: next(results), attempts=3, base_delay=0.0, accept=bool,
+        on_retry=lambda exc, k: seen.append((exc, k)), sleep=lambda s: None,
+    )
+    assert seen == [(None, 2)]
+
+
+def test_attempts_must_be_positive():
+    with pytest.raises(ValueError, match="attempts"):
+        with_backoff(lambda: 1, attempts=0)
+
+
+def test_policy_from_flags_ms_units_and_defaults():
+    p = BackoffPolicy.from_flags(
+        {"retryAttempts": "7", "retryBaseDelayMs": "250",
+         "retryJitterMs": "50", "retryTimeoutMs": "3000"},
+    )
+    assert p.attempts == 7
+    assert p.base_delay == pytest.approx(0.25)
+    assert p.jitter == pytest.approx(0.05)
+    assert p.timeout == pytest.approx(3.0)
+    # defaults pass through when flags are absent; kwargs override them
+    q = BackoffPolicy.from_flags({}, attempts=2, base_delay=0.01)
+    assert (q.attempts, q.base_delay, q.timeout) == (2, 0.01, None)
+
+
+def test_policy_prefix_namespaces_flags():
+    p = BackoffPolicy.from_flags(
+        {"sendAttempts": "2", "retryAttempts": "9"}, prefix="send"
+    )
+    assert p.attempts == 2

@@ -111,6 +111,130 @@ class FakePollingConsumer:
         return item
 
 
+class DeadProducer:
+    """Broker gone mid-run: every send raises, and so does close()."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def send(self, topic, value):
+        self.calls += 1
+        raise ConnectionError("broker gone")
+
+    def close(self):
+        raise RuntimeError("already dead")
+
+
+def test_producer_sinks_degrade_when_broker_dies(capsys):
+    """A producer that fails mid-run downgrades topic publication to
+    warnings + drop counting — it must never raise out of the streaming
+    pump loop (the job and its file sinks keep flowing)."""
+    from omldm_tpu.utils.backoff import BackoffPolicy
+
+    sinks = ProducerSinks(
+        DeadProducer(), retry=BackoffPolicy(attempts=2, base_delay=0.0)
+    )
+    for i in range(5):
+        sinks.on_performance({"i": i})  # must not raise
+    assert sinks.dropped == 5
+    sinks.close()  # a dead client's close() must not mask shutdown either
+    err = capsys.readouterr().err
+    assert "dropping record" in err
+    assert "5 output record(s) dropped" in err
+
+
+def test_producer_sinks_breaker_stops_paying_retries():
+    """After _BREAKER_AFTER consecutive exhausted sends the sink stops
+    retrying (one probe per record, no backoff) so a dead broker does not
+    multiply the pump loop's wall-clock; a healed broker closes the
+    breaker again via the probe."""
+    from omldm_tpu.utils.backoff import BackoffPolicy
+
+    class HealableProducer:
+        def __init__(self):
+            self.calls = 0
+            self.dead = True
+            self.sent = []
+
+        def send(self, topic, value):
+            self.calls += 1
+            if self.dead:
+                raise ConnectionError("broker gone")
+            self.sent.append((topic, value))
+
+    producer = HealableProducer()
+    sinks = ProducerSinks(
+        producer, retry=BackoffPolicy(attempts=2, base_delay=0.0)
+    )
+    trip = sinks._BREAKER_AFTER
+    for i in range(trip + 10):
+        sinks.on_performance({"i": i})
+    # first `trip` records paid 2 attempts each; the rest probed once
+    assert producer.calls == trip * 2 + 10
+    assert sinks.dropped == trip + 10
+    producer.dead = False  # broker heals: the probe succeeds and resets
+    sinks.on_performance({"ok": 1})
+    assert len(producer.sent) == 1
+    assert sinks._consecutive_failures == 0
+    # closed breaker: full retry budget is back for the next failure
+    producer.dead = True
+    before = producer.calls
+    sinks.on_performance({"i": -1})
+    assert producer.calls == before + 2
+
+
+def test_producer_sinks_retry_recovers_transient_send():
+    from omldm_tpu.utils.backoff import BackoffPolicy
+
+    class FlakyProducer:
+        def __init__(self):
+            self.calls = 0
+            self.sent = []
+
+        def send(self, topic, value):
+            self.calls += 1
+            if self.calls <= 2:
+                raise ConnectionError("transient")
+            self.sent.append((topic, value))
+
+    producer = FlakyProducer()
+    sinks = ProducerSinks(
+        producer, retry=BackoffPolicy(attempts=3, base_delay=0.0)
+    )
+    sinks.on_performance({"ok": 1})
+    assert sinks.dropped == 0
+    assert len(producer.sent) == 1
+
+
+def test_partitions_with_retry():
+    """partitions_for_topic returning None transiently (fresh client, no
+    metadata yet) retries under the shared policy; a still-empty answer
+    after the budget comes back as None so callers keep their degrade
+    paths."""
+    from omldm_tpu.runtime.kafka_io import _partitions_with_retry
+    from omldm_tpu.utils.backoff import BackoffPolicy
+
+    class LaggingMetadata:
+        def __init__(self, ready_after):
+            self.calls = 0
+            self.ready_after = ready_after
+
+        def partitions_for_topic(self, topic):
+            self.calls += 1
+            return {0, 2, 1} if self.calls >= self.ready_after else None
+
+    ok = LaggingMetadata(ready_after=3)
+    policy = BackoffPolicy(attempts=5, base_delay=0.0)
+    assert _partitions_with_retry(ok, "t", policy) == {0, 1, 2}
+    assert ok.calls == 3
+
+    never = LaggingMetadata(ready_after=99)
+    assert _partitions_with_retry(
+        never, "t", BackoffPolicy(attempts=2, base_delay=0.0)
+    ) is None
+    assert never.calls == 2
+
+
 def test_polling_events_yields_idle_markers():
     """The polling adapter never ends: quiet windows come out as None so the
     driver can run the silence-timer termination check."""
