@@ -547,10 +547,22 @@ def bench_criteo_sparse_stream_e2e(steps, n_records=300_000):
     overlapped_measured = n_records / t_overlapped
 
     os.unlink(tmp.name)
+    from omldm_tpu.ops.sparse import _resolve_impl
+
+    n_threads = bridge_h._make_coo_parser().n_threads
     return "criteo_sparse_stream_e2e_2e18", overlapped_measured, {
         "basis": "e2e stream-fed, MEASURED double-buffered overlapped run",
         "records": n_records,
         "stream_mb": round(n_bytes / 1e6, 1),
+        # which of the three scatter kernels the calibration table picked
+        # for this width/batch on the active backend, and which sparse
+        # ingest route the bridge resolved (ops/sparse_dispatch.json;
+        # SparseSPMDBridge._use_fused_coo)
+        "scatter_impl": _resolve_impl(dim, 4096 * 40),
+        "ingest_route": (
+            "mt-parse+c-staging" if n_threads > 1 else "fused-line-loop"
+        ),
+        "parser_threads": n_threads,
         "overlapped_measured_examples_per_sec": round(overlapped_measured, 1),
         "overlapped_samples_s": [round(t, 3) for t in overlapped_samples],
         "overlapped_vs_bound": round(overlapped_measured / corrected, 3),
@@ -573,8 +585,9 @@ def bench_criteo_sparse_stream_e2e(steps, n_records=300_000):
             "thread applies stage k at the separately-measured device "
             "scatter rate); bound = n / max(t_host, t_device). The host "
             "side is the C padded-COO parser (zlib-CRC32 categorical "
-            "hashing in C), the device side the scatter path (MXU kron "
-            "kernel auto-dispatched on TPU at this width)"
+            "hashing in C) feeding the fused C holdout/staging pass; the "
+            "device side the scatter path, dispatched from the "
+            "calibration table (ops/sparse_dispatch.json)"
         ),
     }
 

@@ -52,6 +52,14 @@ class SparseLinear(Learner):
         idx, val = self._with_bias(params, x)
         return sparse_matvec(params["w"], idx, val), (idx, val)
 
+    def _scatter(self, w, idx, coef, val):
+        """Calibrated scatter dispatch; ``dataStructure.scatterImpl`` pins
+        a kernel per pipeline (the config twin of OMLDM_SPARSE_SCATTER —
+        see ops/sparse._resolve_impl for the precedence chain)."""
+        return sparse_scatter_add_auto(
+            w, idx, coef, val, impl=self.ds.get("scatterImpl")
+        )
+
     def update_per_record(self, params, x, y, mask):
         """Exact per-record online pass over a sparse batch (the base-class
         default slices dense rows; COO batches slice per leaf)."""
@@ -92,7 +100,7 @@ class SparsePAClassifier(SparseLinear):
         tau = _pa_tau(hinge, sparse_sq_norm(val), variant, C)
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         coef = tau * ys * mask / denom
-        w = sparse_scatter_add_auto(params["w"], idx, coef, val)
+        w = self._scatter(params["w"], idx, coef, val)
         return {"w": w}, masked_mean(hinge, mask)
 
 
@@ -121,7 +129,7 @@ class SparsePARegressor(SparseLinear):
         tau = _pa_tau(l, sparse_sq_norm(val), variant, C)
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         coef = -jnp.sign(err) * tau * mask / denom
-        w = sparse_scatter_add_auto(params["w"], idx, coef, val)
+        w = self._scatter(params["w"], idx, coef, val)
         return {"w": w}, masked_mean(l, mask)
 
 
@@ -160,7 +168,7 @@ class SparseSVM(SparseLinear):
         eta = 1.0 / (lam * params["t"])
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         w = params["w"] * (1.0 - eta * lam)
-        w = sparse_scatter_add_auto(w, idx, eta * ys * viol / denom, val)
+        w = self._scatter(w, idx, eta * ys * viol / denom, val)
         return (
             {"w": w, "t": params["t"] + 1.0},
             masked_mean(hinge, mask),

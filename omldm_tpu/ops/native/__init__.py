@@ -12,6 +12,7 @@ from omldm_tpu.ops.native.loader import (
     FastParser,
     FusedStage,
     SparseFastParser,
+    SparseFusedStage,
     fast_parser_available,
 )
 
@@ -19,5 +20,6 @@ __all__ = [
     "FastParser",
     "FusedStage",
     "SparseFastParser",
+    "SparseFusedStage",
     "fast_parser_available",
 ]

@@ -1371,6 +1371,160 @@ int omldm_parse_stage(const char* buf, long long len, OmldmStageCtx* ctx,
   return 0;
 }
 
+// --- fused SPARSE parse -> holdout -> stage ------------------------------
+//
+// The padded-COO twin of omldm_parse_stage: the sparse e2e hot loop
+// (SparseSPMDBridge.ingest_file -> _consume_coo_block -> _train_sparse_rows
+// -> _stage_coo) re-touched every row several times in numpy — per-block
+// output allocation + concatenate in the parser driver, the vectorized
+// holdout split (mask/argsort/concatenate), and the stage memcpy. This
+// entry parses each line DIRECTLY into its COO stage slot, runs the 8-of-10
+// holdout cycle in place against the sparse holdout ring (idx/val/y
+// triple), and swaps evicted rows into the arriving row's slot — exact
+// SparseHoldout.append_many + _holdout_then_stage parity, pinned by
+// tests/test_sparse_spmd_bridge.py. Specials (Python-codec fallbacks AND
+// forecasts — both re-enter through DataInstance.from_json -> handle_data
+// exactly like the block route's special path) return control to the
+// caller; the hot loop stays pure C.
+struct OmldmSparseStageCtx {
+  int32_t* stage_i;     // [stage_cap, max_nnz] COO index stage
+  float* stage_v;       // [stage_cap, max_nnz] COO value stage
+  float* stage_y;       // [stage_cap]
+  long long stage_cap;
+  long long stage_n;
+  int32_t* hold_i;      // [hold_cap, max_nnz] holdout ring
+  float* hold_v;        // [hold_cap, max_nnz]
+  float* hold_y;        // [hold_cap]
+  long long hold_cap;
+  long long hold_n;
+  long long hold_head;      // oldest element
+  long long holdout_count;  // position in the 0-9 holdout cycle
+  int max_nnz;
+  int dense_budget;         // positional slots before the hashed region
+  long long hash_space;
+  int test_enabled;
+};
+
+namespace {
+
+// Holdout-split one COO training row already sitting in its stage slot
+// (the sparse form of stage_holdout_slot; same return convention).
+inline int sparse_stage_holdout_slot(OmldmSparseStageCtx* ctx, int32_t* si,
+                                     float* sv, float yv) {
+  long long cyc = ctx->holdout_count % 10;
+  ctx->holdout_count++;
+  if (ctx->test_enabled && cyc >= 8 && ctx->hold_cap > 0) {
+    const size_t k = static_cast<size_t>(ctx->max_nnz);
+    if (ctx->hold_n < ctx->hold_cap) {
+      long long pos = (ctx->hold_head + ctx->hold_n) % ctx->hold_cap;
+      memcpy(ctx->hold_i + pos * static_cast<long long>(k), si,
+             sizeof(int32_t) * k);
+      memcpy(ctx->hold_v + pos * static_cast<long long>(k), sv,
+             sizeof(float) * k);
+      ctx->hold_y[pos] = yv;
+      ctx->hold_n++;
+      return 0;
+    }
+    // ring full: swap the oldest row into this slot (it re-enters training
+    // at the evicting row's stream position) and store the arriving row
+    long long pos = ctx->hold_head;
+    int32_t* ri = ctx->hold_i + pos * static_cast<long long>(k);
+    float* rv = ctx->hold_v + pos * static_cast<long long>(k);
+    for (size_t i = 0; i < k; ++i) {
+      int32_t ti = ri[i];
+      ri[i] = si[i];
+      si[i] = ti;
+      float tv = rv[i];
+      rv[i] = sv[i];
+      sv[i] = tv;
+    }
+    float ty = ctx->hold_y[pos];
+    ctx->hold_y[pos] = yv;
+    yv = ty;
+    ctx->hold_head = (ctx->hold_head + 1) % ctx->hold_cap;
+  }
+  ctx->stage_y[ctx->stage_n] = yv;
+  ctx->stage_n++;
+  return 1;
+}
+
+}  // namespace
+
+// Parse a block of whole JSON lines straight into the COO staging buffers.
+// Returns:
+//   0  buffer fully consumed
+//   1  stage full (caller launches the staged step, resets stage_n, resumes)
+//   2  special line (codec fallback OR forecast — the caller re-parses
+//      [*special_off, +*special_len) with the Python codec, whose
+//      handle_data path serves forecasts and odd schemas identically to
+//      the block route)
+// *bytes_consumed is the resume offset relative to buf in all cases (for
+// 2 it points past the special line).
+int omldm_parse_stage_sparse(const char* buf, long long len,
+                             OmldmSparseStageCtx* ctx,
+                             long long* bytes_consumed,
+                             long long* special_off,
+                             long long* special_len) {
+  const char* p = buf;
+  const char* bufend = buf + len;
+  const int k = ctx->max_nnz;
+  const bool hash_fits =
+      ctx->hash_space > 0 && ctx->hash_space <= 0xFFFFFFFFL;
+  const FastMod hash_mod(
+      hash_fits ? static_cast<uint32_t>(ctx->hash_space) : 1u);
+  while (p < bufend) {
+    if (ctx->stage_n >= ctx->stage_cap) {
+      *bytes_consumed = p - buf;
+      return 1;
+    }
+    const char* nl = static_cast<const char*>(memchr(p, '\n', bufend - p));
+    const char* line_end = nl ? nl : bufend;
+    const char* next = nl ? nl + 1 : bufend;
+    int32_t* si = ctx->stage_i + ctx->stage_n * static_cast<long long>(k);
+    float* sv = ctx->stage_v + ctx->stage_n * static_cast<long long>(k);
+    float yv;
+    unsigned char opv, validv;
+    parse_one_line_sparse(p, line_end, ctx->dense_budget, ctx->hash_space,
+                          hash_mod, k, si, sv, &yv, &opv, &validv);
+    if (validv == 1 && opv == 0) {
+      sparse_stage_holdout_slot(ctx, si, sv, yv);
+    } else if (validv == 2 || (validv == 1 && opv == 1)) {
+      *special_off = p - buf;
+      *special_len = line_end - p;
+      *bytes_consumed = next - buf;
+      return 2;
+    }
+    p = next;
+  }
+  *bytes_consumed = len;
+  return 0;
+}
+
+// Stage a run of ALREADY-PARSED COO training rows: the staging tail of the
+// multithreaded block route (omldm_parse_lines_sparse_mt parses on all
+// cores, then this serial pass runs the 8-of-10 holdout cycle + ring swap
+// + stage memcpy in C — the work the numpy _holdout_then_stage/_stage_coo
+// pair used to do with mask/argsort/concatenate per block). Pauses at
+// stage-full so the caller can launch the staged step; returns rows
+// consumed from [0, n). Bit-identical to the fused line loop above and to
+// the numpy route (all three share the per-record holdout semantics).
+long long omldm_stage_coo_rows(OmldmSparseStageCtx* ctx, const int32_t* idx,
+                               const float* val, const float* y,
+                               long long n) {
+  const long long k = ctx->max_nnz;
+  long long i = 0;
+  while (i < n) {
+    if (ctx->stage_n >= ctx->stage_cap) break;
+    int32_t* si = ctx->stage_i + ctx->stage_n * k;
+    float* sv = ctx->stage_v + ctx->stage_n * k;
+    memcpy(si, idx + i * k, sizeof(int32_t) * static_cast<size_t>(k));
+    memcpy(sv, val + i * k, sizeof(float) * static_cast<size_t>(k));
+    sparse_stage_holdout_slot(ctx, si, sv, y[i]);
+    ++i;
+  }
+  return i;
+}
+
 // Sparse bulk entry: JSON lines -> padded-COO (idx[max_nnz], val[max_nnz])
 // rows + targets/ops/valid, mirroring omldm_parse_lines' contract.
 int omldm_parse_lines_sparse(const char* buf, long len, int dense_budget,

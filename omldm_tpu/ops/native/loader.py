@@ -99,6 +99,17 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_longlong, ctypes.POINTER(StageCtx),
         ll_p, ll_p, ll_p, f_p, f_p,
     ]
+    lib.omldm_parse_stage_sparse.restype = ctypes.c_int
+    lib.omldm_parse_stage_sparse.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.POINTER(SparseStageCtx), ll_p, ll_p, ll_p,
+    ]
+    lib.omldm_stage_coo_rows.restype = ctypes.c_longlong
+    lib.omldm_stage_coo_rows.argtypes = [
+        ctypes.POINTER(SparseStageCtx), i32_p,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_longlong,
+    ]
     return lib
 
 
@@ -119,6 +130,31 @@ class StageCtx(ctypes.Structure):
         ("holdout_count", ctypes.c_longlong),
         ("row_stride", ctypes.c_longlong),
         ("n_features", ctypes.c_int),
+        ("test_enabled", ctypes.c_int),
+    ]
+
+
+class SparseStageCtx(ctypes.Structure):
+    """Mirror of OmldmSparseStageCtx (fastparse.cpp): the fused sparse
+    parse->holdout->stage loop's view of the caller's padded-COO staging
+    buffers and holdout ring."""
+
+    _fields_ = [
+        ("stage_i", ctypes.POINTER(ctypes.c_int32)),
+        ("stage_v", ctypes.POINTER(ctypes.c_float)),
+        ("stage_y", ctypes.POINTER(ctypes.c_float)),
+        ("stage_cap", ctypes.c_longlong),
+        ("stage_n", ctypes.c_longlong),
+        ("hold_i", ctypes.POINTER(ctypes.c_int32)),
+        ("hold_v", ctypes.POINTER(ctypes.c_float)),
+        ("hold_y", ctypes.POINTER(ctypes.c_float)),
+        ("hold_cap", ctypes.c_longlong),
+        ("hold_n", ctypes.c_longlong),
+        ("hold_head", ctypes.c_longlong),
+        ("holdout_count", ctypes.c_longlong),
+        ("max_nnz", ctypes.c_int),
+        ("dense_budget", ctypes.c_int),
+        ("hash_space", ctypes.c_longlong),
         ("test_enabled", ctypes.c_int),
     ]
 
@@ -146,7 +182,7 @@ class SparseFastParser:
     bit-identical to SparseVectorizer.vectorize (fuzz-pinned)."""
 
     def __init__(self, dense_budget: int, hash_space: int, max_nnz: int,
-                 n_threads: int = 0):
+                 n_threads: int = 0, reuse_buffers: bool = False):
         self.dense_budget = dense_budget
         self.hash_space = hash_space
         self.max_nnz = max_nnz
@@ -158,22 +194,48 @@ class SparseFastParser:
         if n_threads <= 0:
             n_threads = min(os.cpu_count() or 1, 8)
         self.n_threads = int(n_threads)
+        # reuse_buffers: return VIEWS into a persistent scratch instead of
+        # fresh np.empty outputs per call. Fresh multi-MB allocations come
+        # back from the allocator as unfaulted mmap pages, so the C parser
+        # pays a page fault every 4 KB it writes plus munmap TLB
+        # shootdowns on free — measured ~15% of the whole sparse parse at
+        # Criteo chunk sizes. Only callers that finish with the returned
+        # arrays before the next parse call may opt in (the bridge ingest
+        # routes do: staging memcpys/copies complete per chunk).
+        self.reuse_buffers = bool(reuse_buffers)
+        self._scratch = None
         lib = _get_lib()
         if lib is None:
             raise RuntimeError("native fast parser unavailable (g++ build failed)")
         self._lib = lib
 
-    def _parse_at(self, addr: int, length: int, n_cap: int):
+    def _outputs(self, n_cap: int):
         k = self.max_nnz
-        idx = np.empty((n_cap, k), np.int32)
-        val = np.empty((n_cap, k), np.float32)
-        y = np.empty((n_cap,), np.float32)
-        op = np.empty((n_cap,), np.uint8)
-        valid = np.empty((n_cap,), np.uint8)
+        if not self.reuse_buffers:
+            return (
+                np.empty((n_cap, k), np.int32),
+                np.empty((n_cap, k), np.float32),
+                np.empty((n_cap,), np.float32),
+                np.empty((n_cap,), np.uint8),
+                np.empty((n_cap,), np.uint8),
+            )
+        if self._scratch is None or self._scratch[0].shape[0] < n_cap:
+            self._scratch = (
+                np.empty((n_cap, k), np.int32),
+                np.empty((n_cap, k), np.float32),
+                np.empty((n_cap,), np.float32),
+                np.empty((n_cap,), np.uint8),
+                np.empty((n_cap,), np.uint8),
+            )
+        return self._scratch
+
+    def _parse_at(self, addr: int, length: int, n_cap: int):
+        idx, val, y, op, valid = self._outputs(n_cap)
+        n_cap = idx.shape[0]  # a grown scratch can take more rows
         done = ctypes.c_long(0)
         common = (
             ctypes.c_void_p(addr), length, self.dense_budget,
-            self.hash_space, k, n_cap,
+            self.hash_space, self.max_nnz, n_cap,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -190,32 +252,59 @@ class SparseFastParser:
             )
         return idx[:n], val[:n], y[:n], op[:n], valid[:n], done.value
 
-    def parse(self, data: bytes):
-        if not data:
-            k = self.max_nnz
-            return (
-                np.empty((0, k), np.int32), np.empty((0, k), np.float32),
-                np.empty(0, np.float32), np.empty(0, np.uint8),
-                np.empty(0, np.uint8),
-            )
-        addr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
-        length = len(data)
+    def _empty(self):
+        k = self.max_nnz
+        return (
+            np.empty((0, k), np.int32), np.empty((0, k), np.float32),
+            np.empty(0, np.float32), np.empty(0, np.uint8),
+            np.empty(0, np.uint8),
+        )
+
+    def _parse_region(self, addr: int, length: int, nl_sample: int):
         # size the row estimate from a sampled average line length (sparse
         # records run hundreds of bytes; a fixed 48-byte guess would
         # over-allocate the [n, K] outputs several-fold)
         window = min(length, 1 << 16)
-        nl = data[:window].count(b"\n")
-        avg = max(window // max(nl, 1), 8)
+        avg = max(window // max(nl_sample, 1), 8)
         est = length // avg + length // (8 * avg) + 16
         parts = []
         offset = 0
         while offset < length:
+            if parts and self.reuse_buffers:
+                # a second pass reuses the scratch the previous part views:
+                # materialize it first (rare — only on an underestimate)
+                parts[-1] = tuple(np.array(a, copy=True) for a in parts[-1])
             out = self._parse_at(addr + offset, length - offset, est)
             parts.append(out[:5])
             offset += out[5]
             est = (length - offset) // avg + 16
+        if len(parts) == 1:
+            return parts[0]
         return tuple(
             np.concatenate([p[i] for p in parts]) for i in range(5)
+        )
+
+    def parse(self, data: bytes):
+        if not data:
+            return self._empty()
+        addr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+        length = len(data)
+        return self._parse_region(
+            addr, length, data[: min(length, 1 << 16)].count(b"\n")
+        )
+
+    def parse_range(self, buf: bytearray, start: int, stop: int):
+        """Zero-copy parse of ``buf[start:stop]`` (a writable buffer the
+        caller reuses across reads — the sparse block-ingest path; bytes
+        are only materialized when a line needs the Python fallback)."""
+        if stop <= start:
+            return self._empty()
+        base = ctypes.addressof(
+            (ctypes.c_char * len(buf)).from_buffer(buf)
+        )
+        window_stop = min(stop, start + (1 << 16))
+        return self._parse_region(
+            base + start, stop - start, buf.count(b"\n", start, window_stop)
         )
 
 
@@ -294,6 +383,111 @@ class FusedStage:
 
     def forecast_row(self):
         return self._fore_x, float(self._fore_y.value)
+
+
+class SparseFusedStage:
+    """Driver for the fused sparse C parse->holdout->stage loop
+    (omldm_parse_stage_sparse): the padded-COO twin of :class:`FusedStage`.
+
+    Owns the ctypes ``SparseStageCtx`` describing the caller's COO staging
+    buffers and sparse holdout ring; the caller syncs the mutable cursors
+    (stage_n, holdout ring state, holdout cycle counter) in before each C
+    call and out after, exactly like the dense driver. Specials (Python
+    fallbacks AND forecasts) surface as one RC_SPECIAL code — both re-enter
+    through the Python codec's handle_data path, matching the block route's
+    special handling byte for byte."""
+
+    RC_DONE = 0        # buffer fully consumed
+    RC_STAGE_FULL = 1  # caller launches the staged step and resumes
+    RC_SPECIAL = 2     # line re-enters via DataInstance.from_json
+
+    def __init__(
+        self,
+        stage_i: np.ndarray,
+        stage_v: np.ndarray,
+        stage_y: np.ndarray,
+        hold_i: np.ndarray,
+        hold_v: np.ndarray,
+        hold_y: np.ndarray,
+        dense_budget: int,
+        hash_space: int,
+        test_enabled: bool,
+    ):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native fast parser unavailable (g++ build failed)")
+        self._lib = lib
+        for a, dt in (
+            (stage_i, np.int32), (stage_v, np.float32), (stage_y, np.float32),
+            (hold_i, np.int32), (hold_v, np.float32), (hold_y, np.float32),
+        ):
+            if a.dtype != dt or not a.flags.c_contiguous:
+                raise ValueError(
+                    "fused sparse stage buffers must be C-contiguous "
+                    "int32 idx / float32 val,y"
+                )
+        if stage_i.shape[1] != hold_i.shape[1]:
+            raise ValueError("stage/holdout max_nnz differ")
+        # keep the arrays alive for the ctx's pointer lifetime
+        self._arrays = (stage_i, stage_v, stage_y, hold_i, hold_v, hold_y)
+        f_p = ctypes.POINTER(ctypes.c_float)
+        i_p = ctypes.POINTER(ctypes.c_int32)
+        self.ctx = SparseStageCtx(
+            stage_i=stage_i.ctypes.data_as(i_p),
+            stage_v=stage_v.ctypes.data_as(f_p),
+            stage_y=stage_y.ctypes.data_as(f_p),
+            stage_cap=stage_i.shape[0],
+            stage_n=0,
+            hold_i=hold_i.ctypes.data_as(i_p),
+            hold_v=hold_v.ctypes.data_as(f_p),
+            hold_y=hold_y.ctypes.data_as(f_p),
+            hold_cap=hold_i.shape[0],
+            hold_n=0,
+            hold_head=0,
+            holdout_count=0,
+            max_nnz=stage_i.shape[1],
+            dense_budget=dense_budget,
+            hash_space=hash_space,
+            test_enabled=1 if test_enabled else 0,
+        )
+
+    def parse_stage(self, buf: bytearray, start: int, stop: int):
+        """One C call over ``buf[start:stop]`` (whole JSON lines only).
+        Returns (rc, consumed, special_off, special_len); offsets are
+        relative to ``start``."""
+        base = ctypes.addressof((ctypes.c_char * len(buf)).from_buffer(buf))
+        consumed = ctypes.c_longlong(0)
+        soff = ctypes.c_longlong(0)
+        slen = ctypes.c_longlong(0)
+        rc = self._lib.omldm_parse_stage_sparse(
+            base + start,
+            stop - start,
+            ctypes.byref(self.ctx),
+            ctypes.byref(consumed),
+            ctypes.byref(soff),
+            ctypes.byref(slen),
+        )
+        return rc, consumed.value, soff.value, slen.value
+
+    def stage_rows(
+        self, idx: np.ndarray, val: np.ndarray, y: np.ndarray, start: int
+    ) -> int:
+        """Holdout + stage already-parsed COO rows ``[start, n)`` through
+        the C stager (omldm_stage_coo_rows — the MT block route's staging
+        tail). Pauses at stage-full; returns rows consumed."""
+        n = idx.shape[0] - start
+        if n <= 0:
+            return 0
+        iv, vv, yv = idx[start:], val[start:], y[start:]
+        return int(
+            self._lib.omldm_stage_coo_rows(
+                ctypes.byref(self.ctx),
+                iv.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                vv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                yv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                n,
+            )
+        )
 
 
 class FastParser:

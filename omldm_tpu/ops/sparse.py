@@ -18,6 +18,8 @@ TPU and the surrounding elementwise work fuses.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -111,16 +113,127 @@ def sparse_scatter_add_mxu(
     return w + (flat[:d] if r * c != d else flat)
 
 
-def sparse_scatter_add_auto(
+def sparse_scatter_add_segsum(
     w: jnp.ndarray, idx: jnp.ndarray, coef: jnp.ndarray, val: jnp.ndarray
 ) -> jnp.ndarray:
-    """Backend dispatch (resolved at trace time): the MXU reformulation on
-    TPU at the hashed widths where XLA's serialized scatter is the
-    bottleneck; the plain scatter elsewhere (CPU tests, narrow models
-    where the one-hot FLOPs dominate)."""
-    if jax.default_backend() == "tpu" and w.shape[0] >= (1 << 16):
-        return sparse_scatter_add_mxu(w, idx, coef, val)
-    return sparse_scatter_add(w, idx, coef, val)
+    """The SAME scatter-add with duplicate indices PRE-COMBINED by a sort +
+    segmented sum before the scatter touches ``w``.
+
+    Hashed categorical batches are duplicate-heavy: popular category values
+    repeat across most records of a batch, so the B*K raw updates collapse
+    onto far fewer distinct rows. XLA's TPU scatter serializes per update
+    row; this formulation moves the duplicate work into a bitonic sort and
+    a segment sum (both fully vectorized on TPU), leaving the scatter with
+    one combined update per distinct index and inert (idx 0, val 0) pads
+    for the rest — the module's standard padding convention.
+
+    Shapes stay static: with R <= n distinct indices, run totals land
+    compactly in the first R slots of an [n] array via sorted segment ids,
+    and slots >= R scatter a zero onto row 0. Numerics: per-row totals are
+    plain f32 sums of the row's updates (no prefix-difference
+    cancellation); only the accumulation ORDER differs from the direct
+    scatter, the same 2e-5 envelope as the MXU twin
+    (tests/test_sparse.py).
+
+    Reference counterpart: SparseVector updates applied element-by-element
+    on the JVM (DataPointParser.scala:4,20-47); this is the dedup-first
+    TPU-native form.
+    """
+    n = idx.size
+    flat_idx = idx.reshape(n)
+    u = (coef[:, None] * val).reshape(n).astype(jnp.float32)
+    si, su = jax.lax.sort_key_val(flat_idx, u)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), si[1:] != si[:-1]]
+    )
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1       # run id, sorted
+    run_total = jax.ops.segment_sum(
+        su, seg, num_segments=n, indices_are_sorted=True
+    )                                                      # [n], first R real
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # run start positions compacted to the front (pads sort to the tail)
+    start_pos = jnp.sort(jnp.where(is_start, pos, n))
+    real = start_pos < n
+    run_idx = jnp.where(real, si[jnp.minimum(start_pos, n - 1)], 0)
+    return w.at[run_idx].add(jnp.where(real, run_total, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# scatter dispatch: calibration table + env/config override
+# ---------------------------------------------------------------------------
+
+SCATTER_IMPLS = {
+    "scatter": sparse_scatter_add,
+    "mxu": sparse_scatter_add_mxu,
+    "segsum": sparse_scatter_add_segsum,
+}
+
+# env knob: OMLDM_SPARSE_SCATTER = scatter | mxu | segsum | auto ("auto" or
+# unset reads the calibration table); config twin: dataStructure
+# {"scatterImpl": "..."} on the sparse learner spec (learners pass impl=).
+_ENV_KNOB = "OMLDM_SPARSE_SCATTER"
+
+
+def _resolve_impl(d: int, n_updates: int, impl=None) -> str:
+    """Trace-time dispatch decision, in precedence order: explicit config
+    (``impl`` argument, from dataStructure.scatterImpl), the
+    OMLDM_SPARSE_SCATTER env var, the persisted calibration table
+    (ops/sparse_dispatch.json, nearest (D, updates) grid point for this
+    backend), and only then the pre-calibration guess.
+
+    The guess documents the measured record so far: XLA's TPU scatter
+    serializes at ~66M updates/s regardless of D
+    (benchmarks/sparse_scatter_experiment.py), and the MXU reformulation
+    costs ~2*2*D FLOPs per update — at D >= 2^16 on a v5e-class MXU the
+    contraction clears the serialized scatter, below it the one-hot FLOPs
+    dominate. On CPU the committed table (generated by
+    ``python -m omldm_tpu.ops.sparse_calibrate`` on this host) measures
+    the plain scatter fastest through D = 2^18 (12-17M updates/s), but at
+    D = 2^20 the scatter drops to ~8M as the target array falls out of
+    cache and the segsum pre-combine (~10M, D-independent) wins 3 of 4
+    grid points; the MXU formulation never wins off-TPU. Re-calibrate
+    with ``sparse_calibrate --out`` after hardware changes.
+    """
+    if impl:
+        name = str(impl)
+        if name not in SCATTER_IMPLS:
+            raise ValueError(
+                f"unknown sparse scatter impl {name!r}; "
+                f"expected one of {sorted(SCATTER_IMPLS)} "
+            )
+        return name
+    env = os.environ.get(_ENV_KNOB, "").strip().lower()
+    if env and env != "auto":
+        if env not in SCATTER_IMPLS:
+            raise ValueError(
+                f"{_ENV_KNOB}={env!r}: expected "
+                f"{sorted(SCATTER_IMPLS) + ['auto']}"
+            )
+        return env
+    from omldm_tpu.ops.sparse_calibrate import lookup_winner
+
+    winner = lookup_winner(jax.default_backend(), d, n_updates)
+    if winner is not None:
+        return winner
+    # pre-calibration fallback: the round-5 guess, kept only for hosts
+    # with no table entry for their backend
+    if jax.default_backend() == "tpu" and d >= (1 << 16):
+        return "mxu"
+    return "scatter"
+
+
+def sparse_scatter_add_auto(
+    w: jnp.ndarray,
+    idx: jnp.ndarray,
+    coef: jnp.ndarray,
+    val: jnp.ndarray,
+    impl: str = None,
+) -> jnp.ndarray:
+    """Calibrated dispatch (resolved at trace time) between the three
+    scatter formulations; see :func:`_resolve_impl` for the precedence
+    chain and the measured record behind the fallback guess."""
+    name = _resolve_impl(int(w.shape[0]), int(idx.size), impl)
+    return SCATTER_IMPLS[name](w, idx, coef, val)
 
 
 def sparse_scatter_add_outer(

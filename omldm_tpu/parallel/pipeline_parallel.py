@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional
 
 import jax
 
-from omldm_tpu.utils.jaxcompat import axis_size, shard_map
+from omldm_tpu.utils.jaxcompat import axis_size, grad_sync, shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -214,6 +214,9 @@ class PPTrainer:
             loss, grads = jax.value_and_grad(
                 lambda p: pp_lm_loss(cfg, p, tokens, targets, mask)
             )(params)
+            # pre-vma jax: manual psum of replicated leaves' gradients
+            # (no-op where the vma transpose inserts them; jaxcompat)
+            grads = grad_sync(grads, pspecs, ("dp", "pp"))
             new_params, new_opt = adam_update(params, grads, opt, lr, b1, b2, eps)
             return new_params, new_opt, loss
 

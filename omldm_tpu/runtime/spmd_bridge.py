@@ -765,6 +765,11 @@ class SparseSPMDBridge(SPMDBridge):
     identical: 8-of-10 holdout, forecasts at stream position, bucketed
     query responses, termination fragments, byte-accounted statistics."""
 
+    # sparse chunks default to 8 MB (vs the dense 4 MB): the MT parse
+    # amortizes its newline-index pass and thread handoff over longer
+    # line runs — measured ~+8% host throughput on the Criteo stream
+    SPARSE_CHUNK_BYTES = 1 << 23
+
     def __init__(self, request, dim, config, emit_prediction, emit_response):
         super().__init__(request, dim, config, emit_prediction, emit_response)
         from omldm_tpu.runtime.databuffers import SparseHoldout
@@ -785,8 +790,9 @@ class SparseSPMDBridge(SPMDBridge):
         self._stage_n = 0
 
     def supports_fused_ingest(self) -> bool:
-        """The sparse bridge has its own C bulk route (ingest_file below:
-        padded-COO packing with in-C categorical hashing)."""
+        """The sparse bridge has its own C bulk routes (ingest_file below:
+        the fused parse->holdout->stage loop, or padded-COO block packing
+        with in-C categorical hashing)."""
         from omldm_tpu.ops.native import fast_parser_available
 
         return fast_parser_available()
@@ -794,11 +800,91 @@ class SparseSPMDBridge(SPMDBridge):
     # supports_overlapped_ingest: inherited — supports_fused_ingest is
     # polymorphic and the opt-out knob is shared with the dense route.
 
+    def _use_fused_coo(self) -> bool:
+        """The fused C loop (omldm_parse_stage_sparse) is the default file
+        route: it parses each line directly into its COO stage slot with
+        the holdout split in C, where the block route re-touches every row
+        in numpy (parser output allocation, holdout mask/argsort/concat,
+        stage memcpy) — ~2x host throughput measured on the Criteo-shaped
+        stream (benchmarks/run_benchmarks.py:bench_criteo_sparse_stream_e2e).
+        ``{"sparseFusedIngest": false}`` keeps the multithreaded block
+        parser instead (it can win on many-core hosts where the e2e is
+        parse-bound and the fused loop's single parse thread loses to 8
+        MT block threads)."""
+        if not self.supports_fused_ingest():
+            return False
+        flag = str(
+            self.request.training_configuration.extra.get(
+                "sparseFusedIngest", "true"
+            )
+        ).lower()
+        return flag != "false"
+
+    def _sparse_fused_stage(self):
+        from omldm_tpu.ops.native import SparseFusedStage
+
+        if getattr(self, "_fused", None) is None:
+            self._fused = SparseFusedStage(
+                self._stage_i, self._stage_v, self._stage_y,
+                self.test_set._idx, self.test_set._val, self.test_set._y,
+                dense_budget=self.vectorizer.dim - self.vectorizer.hash_space,
+                hash_space=self.vectorizer.hash_space,
+                test_enabled=bool(self.config.test),
+            )
+        return self._fused
+
+    def _fused_consume_sparse(
+        self, fs, buf: bytearray, start: int, stop: int,
+        on_stage_full=None, quiesce=None,
+    ) -> None:
+        """Drive the fused sparse C loop over ``buf[start:stop]`` (whole
+        lines), handing stage launches and special lines back to Python —
+        the COO twin of the dense :meth:`_fused_consume`, with the same
+        cursor-sync contract. Specials (codec fallbacks AND forecasts)
+        re-enter via DataInstance.from_json -> handle_data, which is
+        byte-identical to the block route's special path; ``quiesce``
+        drains the dispatch queue first so the rare path never races the
+        dispatch thread on trainer state."""
+        ctx = fs.ctx
+        off = start
+        while off < stop:
+            ctx.stage_n = self._stage_n
+            ctx.hold_n = self.test_set._n
+            ctx.hold_head = self.test_set._head
+            ctx.holdout_count = self.holdout_count
+            rc, consumed, soff, slen = fs.parse_stage(buf, off, stop)
+            self._stage_n = int(ctx.stage_n)
+            self.test_set._n = int(ctx.hold_n)
+            self.test_set._head = int(ctx.hold_head)
+            self.holdout_count = int(ctx.holdout_count)
+            base = off
+            off += consumed
+            if rc == fs.RC_DONE:
+                return
+            if rc == fs.RC_STAGE_FULL:
+                if on_stage_full is not None:
+                    fs = on_stage_full()
+                    ctx = fs.ctx
+                else:
+                    self._train_staged(full=True)
+            elif rc == fs.RC_SPECIAL:
+                if quiesce is not None:
+                    quiesce()
+                line = bytes(buf[base + soff : base + soff + slen]).decode(
+                    "utf-8", errors="replace"
+                )
+                inst = DataInstance.from_json(line)
+                if inst is not None:
+                    self.handle_data(inst)
+
     def _make_coo_parser(self):
         from omldm_tpu.ops.native import SparseFastParser
 
         # parserThreads: 0 = auto (min(cores, 8), FastParser's rule) —
-        # multi-core hosts parse disjoint line ranges on C threads
+        # multi-core hosts parse disjoint line ranges on C threads.
+        # reuse_buffers: the ingest routes consume every returned array
+        # within the chunk (staging memcpy / holdout copy), so the parser
+        # may hand out scratch views instead of fresh allocations
         return SparseFastParser(
             self.vectorizer.dim - self.vectorizer.hash_space,
             self.vectorizer.hash_space,
@@ -808,30 +894,106 @@ class SparseSPMDBridge(SPMDBridge):
                     "parserThreads", 0
                 )
             ),
+            reuse_buffers=True,
         )
 
     def ingest_file_overlapped(
-        self, path: str, chunk_bytes: int = 1 << 22, on_chunk=None,
+        self, path: str, chunk_bytes: int = SPARSE_CHUNK_BYTES, on_chunk=None,
         depth: int = 2, train_fn=None,
     ) -> None:
-        """DOUBLE-BUFFERED COO ingest: the C padded-COO parse + holdout
-        split + staging fill stage set k+1 while the dispatch thread runs
+        """DOUBLE-BUFFERED COO ingest: the fused C parse -> holdout ->
+        stage loop fills stage set k+1 while the dispatch thread runs
         stage k's collective steps — the sparse e2e path is host-parse
         bound and the device scatter costs about as much, so overlapping
         them approaches max() instead of their sum. Stage sets dispatch
         strictly in order: results are bit-identical to the serial
         :meth:`ingest_file` (pinned by tests/test_overlap.py). Specials
         (forecasts, codec fallbacks) quiesce the queue first, exactly
-        like the dense route."""
-        import queue
-        import threading
-
+        like the dense route. Hosts opting out of the fused loop
+        (``sparseFusedIngest: false``) overlap the MT block route
+        instead (:meth:`_ingest_file_overlapped_blocks`)."""
         if self._paced:
             raise ValueError(
                 "overlapped ingest requires chained launches; SSP's "
                 "per-launch accept flags force the serial path"
             )
-        parser = self._make_coo_parser()
+        use_fused = self._use_fused_coo()
+        parser = self._make_coo_parser() if use_fused else None
+        if not use_fused or parser.n_threads > 1:
+            # multi-core hosts overlap the MT block parse (all cores in
+            # the producer thread, C staging tail) with the dispatch
+            # thread; single-core hosts overlap the fused line loop
+            self._ingest_file_overlapped_blocks(
+                path, chunk_bytes, on_chunk, depth, train_fn, parser
+            )
+            return
+        from omldm_tpu.ops.native import SparseFusedStage
+
+        dense_budget = self.vectorizer.dim - self.vectorizer.hash_space
+
+        def make_set():
+            si = np.zeros_like(self._stage_i)
+            sv = np.zeros_like(self._stage_v)
+            sy = np.zeros_like(self._stage_y)
+            fs = SparseFusedStage(
+                si, sv, sy,
+                self.test_set._idx, self.test_set._val, self.test_set._y,
+                dense_budget=dense_budget,
+                hash_space=self.vectorizer.hash_space,
+                test_enabled=bool(self.config.test),
+            )
+            return (si, sv, sy, fs)
+
+        train = train_fn or (
+            lambda si, sv, sy, n: self._launch_coo(si, sv, sy, n)
+        )
+        disp = _OverlapDispatcher(
+            make_set, depth, lambda s, n: train(s[0], s[1], s[2], n)
+        )
+        current = (
+            self._stage_i, self._stage_v, self._stage_y,
+            self._sparse_fused_stage(),
+        )
+
+        def on_stage_full():
+            nonlocal current
+            current = disp.submit(current, self._stage_cap)
+            self._stage_i, self._stage_v, self._stage_y = current[:3]
+            self._stage_x = self._stage_v  # base-class size probes
+            self._fused = current[3]
+            self._stage_n = 0
+            return current[3]
+
+        try:
+            for buf, stop in _line_aligned_chunks(path, chunk_bytes):
+                # surface a dispatch-thread error at the next chunk
+                # boundary instead of parsing the rest of the file first
+                disp.raise_pending()
+                self._fused_consume_sparse(
+                    current[3], buf, 0, stop,
+                    on_stage_full=on_stage_full, quiesce=disp.quiesce,
+                )
+                if on_chunk is not None:
+                    on_chunk()
+            # final partial stage drains through the same ordered queue
+            n_tail = self._stage_n
+            self._stage_n = 0
+            if n_tail:
+                disp.submit(current, n_tail)
+        finally:
+            disp.close()
+        disp.raise_pending()
+
+    def _ingest_file_overlapped_blocks(
+        self, path: str, chunk_bytes: int, on_chunk, depth: int, train_fn,
+        parser=None,
+    ) -> None:
+        """The block-parse overlapped route: MT parse in the producer
+        thread, C (fused) or numpy holdout/staging, stage sets through
+        the same ordered dispatcher. Also serves ``sparseFusedIngest:
+        false`` hosts."""
+        if parser is None:
+            parser = self._make_coo_parser()
 
         def make_set():
             return (
@@ -851,9 +1013,7 @@ class SparseSPMDBridge(SPMDBridge):
         try:
             for buf, stop in _line_aligned_chunks(path, chunk_bytes):
                 disp.raise_pending()
-                self._consume_coo_block(
-                    parser, bytes(memoryview(buf)[:stop])
-                )
+                self._consume_coo_block(parser, buf, stop)
                 if on_chunk is not None:
                     on_chunk()
             # final partial stage drains through the same ordered queue
@@ -863,6 +1023,8 @@ class SparseSPMDBridge(SPMDBridge):
                 (self._stage_i, self._stage_v, self._stage_y) = disp.submit(
                     (self._stage_i, self._stage_v, self._stage_y), n_tail
                 )
+                self._stage_x = self._stage_v
+                self._fused = None  # C-stager driver follows the swap
         finally:
             self._coo_enqueue = None
             self._coo_quiesce = None
@@ -984,6 +1146,10 @@ class SparseSPMDBridge(SPMDBridge):
                     (self._stage_i, self._stage_v, self._stage_y), n
                 )
             )
+            self._stage_x = self._stage_v  # base-class size probes
+            # the cached C-stager driver points at the buffers that were
+            # just handed to the dispatch thread: rebuild over the new set
+            self._fused = None
             self._stage_n = 0
             return
         self._stage_n = 0
@@ -1111,37 +1277,74 @@ class SparseSPMDBridge(SPMDBridge):
     # --- bulk file ingest via the C sparse parser ---
 
     def ingest_file(
-        self, path: str, chunk_bytes: int = 1 << 22, on_chunk=None
+        self, path: str, chunk_bytes: int = SPARSE_CHUNK_BYTES, on_chunk=None
     ) -> None:
-        """Stream a JSON-lines file through the C padded-COO parser:
-        fast-schema lines pack straight into (idx, val) blocks (zlib-CRC32
-        categorical hashing in C, parity fuzz-pinned by
-        tests/test_sparse_parser.py); fallback lines, forecasts and drops
-        re-route through the per-record codec at their stream position."""
-        parser = self._make_coo_parser()
+        """Stream a JSON-lines file through the fused sparse C loop:
+        every fast-schema line is parsed DIRECTLY into its COO stage slot
+        (zlib-CRC32 categorical hashing in C, parity fuzz-pinned by
+        tests/test_sparse_parser.py) and holdout-split in C — the sparse
+        twin of the dense fused route, bit-identical to the block route
+        (pinned by tests/test_sparse_spmd_bridge.py). Fallback lines,
+        forecasts and drops re-route through the per-record codec at
+        their stream position; ``sparseFusedIngest: false`` keeps the MT
+        block route."""
+        if self._use_fused_coo():
+            parser = self._make_coo_parser()
+            if parser.n_threads <= 1:
+                # single-core host: the fused line loop (one C pass,
+                # parse straight into the stage slot) beats any split
+                for buf, stop in _line_aligned_chunks(path, chunk_bytes):
+                    self._fused_consume_sparse(
+                        self._sparse_fused_stage(), buf, 0, stop
+                    )
+                    if on_chunk is not None:
+                        on_chunk()
+                return
+            # multi-core host: MT block parse on all cores, then the C
+            # stager (_consume_coo_block routes staging through
+            # omldm_stage_coo_rows when the fused path is enabled)
+        else:
+            parser = self._make_coo_parser()
         for buf, stop in _line_aligned_chunks(path, chunk_bytes):
-            # one copy (memoryview slice): the special-line handling needs
-            # real bytes for lazy line splitting anyway
-            self._consume_coo_block(parser, bytes(memoryview(buf)[:stop]))
+            self._consume_coo_block(parser, buf, stop)
             if on_chunk is not None:
                 on_chunk()
 
-    def _consume_coo_block(self, parser, block: bytes) -> None:
-        idx, val, y, op, valid = parser.parse(block)
+    def _consume_coo_block(self, parser, buf, stop: int = None) -> None:
+        """MT block parse of ``buf[:stop]`` (zero-copy out of the reusable
+        read buffer) + vectorized holdout/staging. ``buf`` may also be a
+        plain bytes block (Kafka feeds), in which case ``stop`` defaults
+        to its length."""
+        if stop is None:
+            stop = len(buf)
+        if isinstance(buf, (bytes, memoryview)):
+            block = bytes(buf[:stop])
+            idx, val, y, op, valid = parser.parse(block)
+        else:
+            block = None  # materialized lazily, only for special lines
+            idx, val, y, op, valid = parser.parse_range(buf, 0, stop)
         n = idx.shape[0]
         if n == 0:
             return
         # specials (codec fallbacks, forecasts, drops) break the bulk run
         # so ordering matches per-record delivery exactly
         special = np.nonzero((valid != 1) | (op != 0))[0]
-        lines = block.split(b"\n") if special.size else None
+        lines = None
+        if special.size:
+            if block is None:
+                block = bytes(memoryview(buf)[:stop])
+            lines = block.split(b"\n")
+        # bulk runs of parsed training rows: holdout + stage in C when the
+        # fused path is on (same per-record semantics either way)
+        stage_bulk = (
+            self._stage_parsed_rows if self._use_fused_coo()
+            else self._train_sparse_rows
+        )
         prev = 0
         for s in special:
             s = int(s)
             if s > prev:
-                self._train_sparse_rows(
-                    idx[prev:s], val[prev:s], y[prev:s]
-                )
+                stage_bulk(idx[prev:s], val[prev:s], y[prev:s])
             inst = DataInstance.from_json(
                 lines[s].decode("utf-8", errors="replace")
             )
@@ -1156,4 +1359,31 @@ class SparseSPMDBridge(SPMDBridge):
                 self.handle_data(inst)
             prev = s + 1
         if prev < n:
-            self._train_sparse_rows(idx[prev:], val[prev:], y[prev:])
+            stage_bulk(idx[prev:], val[prev:], y[prev:])
+
+    def _stage_parsed_rows(self, idx, val, y) -> None:
+        """Holdout + stage a run of C-PARSED COO rows through the C stager
+        (omldm_stage_coo_rows): the staging tail of the MT block route,
+        bit-identical to :meth:`_holdout_then_stage` + :meth:`_stage_coo`
+        but with the holdout cycle, ring swap and stage fill in one C pass
+        instead of mask/argsort/concatenate numpy per block. Pauses at
+        stage-full for the launch (or the overlapped dispatch swap)."""
+        n = idx.shape[0]
+        i = 0
+        while i < n:
+            # re-fetch per pass: a stage swap (overlapped dispatch)
+            # invalidates the cached driver
+            fs = self._sparse_fused_stage()
+            ctx = fs.ctx
+            ctx.stage_n = self._stage_n
+            ctx.hold_n = self.test_set._n
+            ctx.hold_head = self.test_set._head
+            ctx.holdout_count = self.holdout_count
+            took = fs.stage_rows(idx, val, y, i)
+            self._stage_n = int(ctx.stage_n)
+            self.test_set._n = int(ctx.hold_n)
+            self.test_set._head = int(ctx.hold_head)
+            self.holdout_count = int(ctx.holdout_count)
+            i += took
+            if self._stage_n >= self._stage_cap:
+                self._train_staged(full=True)

@@ -45,6 +45,61 @@ def pvary(x, axes):
     return x  # pre-vma jax: no varying-axis typing to satisfy
 
 
+def auto_grad_sync() -> bool:
+    """True when shard_map's vma typing makes ``jax.grad`` insert the
+    gradient psums for replicated parameter leaves automatically (the
+    releases that export ``jax.shard_map`` at top level). On older
+    releases the compat :func:`shard_map` must disable replication
+    checking (``check_rep=False`` — the checker rejects the
+    invariant->varying casts newer code expresses with pvary), and THAT
+    also disables the automatic psums: each shard keeps only its local
+    gradient contribution, so replicated params silently drift apart
+    across data/sequence shards. Trainers call :func:`grad_sync` right
+    after ``value_and_grad`` to close the gap."""
+    return hasattr(jax, "shard_map")
+
+
+def grad_sync(grads, pspecs, axis_names):
+    """Manual stand-in for the vma-automatic gradient reduction on pre-vma
+    jax: psum every gradient leaf over the mesh axes ABSENT from its
+    partition spec (a leaf replicated over an axis accumulates partial
+    gradients on each of that axis' shards; a leaf sharded over the axis
+    already owns its slice). No-op — returns ``grads`` untouched — on
+    releases where the automatic psums exist (adding them twice would
+    double-count). Verified equal to the single-device run across
+    dp/sp/tp and dp/pp mesh shapes by tests/test_transformer.py,
+    test_pipeline_parallel.py, test_ulysses.py."""
+    if auto_grad_sync():
+        return grads
+    from jax.sharding import PartitionSpec
+
+    def spec_axes(spec):
+        axes = set()
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                axes.update(part)
+            else:
+                axes.add(part)
+        return axes
+
+    flat_specs = jax.tree_util.tree_leaves(
+        pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    g_flat, tree = jax.tree_util.tree_flatten(grads)
+    if len(g_flat) != len(flat_specs):
+        raise ValueError(
+            f"grad_sync: {len(g_flat)} grad leaves vs "
+            f"{len(flat_specs)} partition specs"
+        )
+    synced = []
+    for g, spec in zip(g_flat, flat_specs):
+        missing = tuple(a for a in axis_names if a not in spec_axes(spec))
+        synced.append(jax.lax.psum(g, missing) if missing else g)
+    return jax.tree_util.tree_unflatten(tree, synced)
+
+
 def axis_size(axis_name) -> int:
     """Static size of a mapped axis inside shard_map.
     ``jax.lax.axis_size`` when present; on older releases the axis env

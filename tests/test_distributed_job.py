@@ -307,7 +307,8 @@ class TestDistributedStreamJob:
         )
         # invalid learner + sparse Create were rejected WITH a reason
         assert "rejecting Create for pipeline 5" in err
-        assert "rejecting pipeline 6: sparse" in err
+        assert "rejecting pipeline 6" in err
+        assert "sparse pipeline cannot share its parse route" in err
         # pipeline 1 trained, then was deleted: only pipeline 0 reports
         assert [s["pipeline"] for s in report["statistics"]] == [0]
         assert "pipeline 1 deleted" in err

@@ -99,7 +99,8 @@ class TestSparseOps:
 
     def test_auto_dispatch_matches_scatter_under_jit(self):
         """sparse_scatter_add_auto resolves at trace time and must be
-        jittable; off-TPU it is the plain scatter bit-for-bit."""
+        jittable; with the explicit scatter impl pinned it is the plain
+        scatter bit-for-bit."""
         import jax
 
         from omldm_tpu.ops.sparse import sparse_scatter_add_auto
@@ -110,7 +111,9 @@ class TestSparseOps:
         idx = rng.randint(0, d, size=(b, k)).astype(np.int32)
         val = rng.randn(b, k).astype(np.float32)
         coef = rng.randn(b).astype(np.float32)
-        out = jax.jit(sparse_scatter_add_auto)(
+        out = jax.jit(
+            lambda *a: sparse_scatter_add_auto(*a, impl="scatter")
+        )(
             jnp.asarray(w), jnp.asarray(idx), jnp.asarray(coef),
             jnp.asarray(val),
         )
@@ -119,6 +122,57 @@ class TestSparseOps:
             jnp.asarray(val),
         )
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_segsum_scatter_matches_xla_scatter(self):
+        """The sort + segmented pre-combine reformulation
+        (sparse_scatter_add_segsum) is the same scatter-add up to f32
+        accumulation order (per-run totals are exact segment sums, no
+        prefix-difference cancellation). Covers DUPLICATE-HEAVY index
+        streams — the hashed-categorical case the pre-combine exists for —
+        plus pad slots and whole-record duplicates."""
+        from omldm_tpu.ops.sparse import sparse_scatter_add_segsum
+
+        rng = np.random.RandomState(11)
+        for d, vocab in ((37, 5), (4096, 3), (4096, 500), (1 << 15, 7)):
+            b, k = 32, 9
+            w = rng.randn(d).astype(np.float32)
+            # duplicate-heavy: every slot draws from a tiny vocabulary
+            idx = rng.choice(
+                rng.randint(0, d, size=vocab), size=(b, k)
+            ).astype(np.int32)
+            idx[:, -2:] = 0  # pad slots (val 0)
+            val = rng.randn(b, k).astype(np.float32)
+            val[:, -2:] = 0.0
+            idx[3] = idx[2]  # whole-record duplicate pattern
+            coef = rng.randn(b).astype(np.float32)
+            ref = sparse_scatter_add(
+                jnp.asarray(w), jnp.asarray(idx), jnp.asarray(coef),
+                jnp.asarray(val),
+            )
+            out = sparse_scatter_add_segsum(
+                jnp.asarray(w), jnp.asarray(idx), jnp.asarray(coef),
+                jnp.asarray(val),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+                err_msg=f"segsum scatter diverged at D={d} vocab={vocab}",
+            )
+
+    def test_dispatch_precedence_env_and_config(self, monkeypatch):
+        """_resolve_impl precedence: explicit config impl > env knob >
+        calibration table > guess. The env knob rejects junk loudly."""
+        from omldm_tpu.ops import sparse as sp
+
+        monkeypatch.delenv("OMLDM_SPARSE_SCATTER", raising=False)
+        assert sp._resolve_impl(300, 40, impl="segsum") == "segsum"
+        monkeypatch.setenv("OMLDM_SPARSE_SCATTER", "mxu")
+        assert sp._resolve_impl(300, 40) == "mxu"
+        assert sp._resolve_impl(300, 40, impl="scatter") == "scatter"
+        monkeypatch.setenv("OMLDM_SPARSE_SCATTER", "bogus")
+        with pytest.raises(ValueError, match="OMLDM_SPARSE_SCATTER"):
+            sp._resolve_impl(300, 40)
+        with pytest.raises(ValueError, match="unknown sparse scatter"):
+            sp._resolve_impl(300, 40, impl="bogus")
 
 
 class TestSparseLearnerTwinEquality:

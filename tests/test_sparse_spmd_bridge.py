@@ -197,3 +197,131 @@ class TestSparseSPMDBridge:
             [(TRAINING_STREAM, l) for l in _lines(900, seed=1)]
         )
         assert rep.statistics[0].fitted > bridge.trainer.fitted
+
+
+class TestFusedSparseStaging:
+    """The three serial sparse file routes — numpy block staging, the fused
+    C line loop (omldm_parse_stage_sparse), and MT block parse + C staging
+    (omldm_stage_coo_rows) — must produce BIT-IDENTICAL staging: same
+    trained params, fitted count, holdout ring and predictions. The
+    overlapped route rides the same contract (≤ bit-identical, pinned
+    exactly). Streams include forecasts, escaped-category fallbacks and
+    DUPLICATE-HEAVY categoricals (tiny vocabularies, the hashed-collision
+    case the segsum pre-combine targets)."""
+
+    def _dup_heavy_lines(self, n, seed=7):
+        """Categoricals drawn from 3-value vocabularies: most batch rows
+        collide onto the same hashed slots."""
+        rng = np.random.RandomState(seed)
+        lines = []
+        for i in range(n):
+            num = [round(float(v), 5) for v in rng.randn(3)]
+            cats = [f"c{rng.randint(3)}", f"d{rng.randint(3)}"]
+            if i % 311 == 50:
+                lines.append(json.dumps({
+                    "numericalFeatures": num,
+                    "categoricalFeatures": cats,
+                    "operation": "forecasting",
+                }))
+                continue
+            if i % 401 == 9:  # escaped category -> Python codec fallback
+                cats[0] = 'a"b'
+            lines.append(json.dumps({
+                "numericalFeatures": num, "categoricalFeatures": cats,
+                "target": float(rng.randint(2)), "operation": "training",
+            }))
+        return lines
+
+    def _bridge(self, extra=None):
+        from omldm_tpu.ops.native import fast_parser_available
+
+        if not fast_parser_available():
+            pytest.skip("native parser unavailable")
+        preds = []
+        job = StreamJob(JobConfig(
+            parallelism=2, batch_size=32, test_set_size=32,
+        ))
+        job.set_sinks(on_prediction=preds.append)
+        job.process_event(
+            REQUEST_STREAM, json.dumps(_create(extra=extra or {}))
+        )
+        [bridge] = job.spmd_bridges.values()
+        return bridge, preds
+
+    def _assert_identical(self, a, b, preds_a, preds_b, label):
+        assert a.trainer.fitted == b.trainer.fitted, label
+        assert a.holdout_count == b.holdout_count, label
+        np.testing.assert_array_equal(
+            np.asarray(a.trainer.global_flat_params()),
+            np.asarray(b.trainer.global_flat_params()),
+            err_msg=label,
+        )
+        ai, av, ay = a.test_set.arrays()
+        bi, bv, by = b.test_set.arrays()
+        np.testing.assert_array_equal(ai, bi, err_msg=label)
+        np.testing.assert_array_equal(av, bv, err_msg=label)
+        np.testing.assert_array_equal(ay, by, err_msg=label)
+        assert len(preds_a) == len(preds_b) > 0, label
+        for pa, pb in zip(preds_a, preds_b):
+            assert pa.value == pb.value, label
+
+    def test_serial_routes_bit_identical(self, tmp_path):
+        path = tmp_path / "dup.jsonl"
+        path.write_text("\n".join(self._dup_heavy_lines(3000)) + "\n")
+        ref, ref_p = self._bridge(
+            {"sparseFusedIngest": "false", "parserThreads": 1}
+        )
+        ref.ingest_file(str(path))
+        ref.flush()
+        for label, extra in (
+            ("numpy block MT", {"sparseFusedIngest": "false",
+                                "parserThreads": 2}),
+            ("fused line loop", {"parserThreads": 1}),
+            ("MT parse + C staging", {"parserThreads": 2}),
+        ):
+            b, p = self._bridge(extra)
+            b.ingest_file(str(path))
+            b.flush()
+            self._assert_identical(b, ref, p, ref_p, label)
+
+    def test_overlapped_matches_serial_duplicate_heavy(self, tmp_path):
+        path = tmp_path / "dup.jsonl"
+        path.write_text("\n".join(self._dup_heavy_lines(3000)) + "\n")
+        ref, ref_p = self._bridge()
+        ref.ingest_file(str(path))
+        ref.flush()
+        for label, extra, kw in (
+            ("overlapped fused line", {"parserThreads": 1}, {"depth": 2}),
+            ("overlapped MT + C", {"parserThreads": 2}, {"depth": 2}),
+            ("overlapped small chunks", {"parserThreads": 2},
+             {"depth": 4, "chunk_bytes": 999}),
+        ):
+            b, p = self._bridge(extra)
+            b.ingest_file_overlapped(str(path), **kw)
+            b.flush()
+            self._assert_identical(b, ref, p, ref_p, label)
+
+    def test_segsum_pipeline_stays_in_twin_envelope(self, tmp_path):
+        """A sparse pipeline trained with the segsum pre-combine pinned
+        (dataStructure.scatterImpl) diverges from the plain-scatter run by
+        <= 2e-5 per parameter on a duplicate-heavy stream — the bridge-level
+        form of the ops twin tests."""
+        path = tmp_path / "dup.jsonl"
+        path.write_text("\n".join(self._dup_heavy_lines(2000)) + "\n")
+        flats = {}
+        for impl in ("scatter", "segsum"):
+            create = _create()
+            create["learner"]["dataStructure"]["scatterImpl"] = impl
+            preds = []
+            job = StreamJob(JobConfig(
+                parallelism=2, batch_size=32, test_set_size=32,
+            ))
+            job.set_sinks(on_prediction=preds.append)
+            job.process_event(REQUEST_STREAM, json.dumps(create))
+            [bridge] = job.spmd_bridges.values()
+            bridge.ingest_file(str(path))
+            bridge.flush()
+            flats[impl] = np.asarray(bridge.trainer.global_flat_params())
+        np.testing.assert_allclose(
+            flats["segsum"], flats["scatter"], rtol=2e-5, atol=2e-5
+        )
