@@ -21,7 +21,18 @@ protocols with device-plane equivalents, `{"engine": "spmd"}` on an
 8-worker virtual mesh): examples/sec, score, logical bytesShipped vs
 physical collective bytes, and host-vs-SPMD score parity per protocol.
 
+`--codec` adds the TRANSPORT CODEC comparison (runtime.codec): the same
+protocols on a params-dominated 256-feature stream, swept over the
+requested codec(s), reporting bytes-on-wire, the reduction vs the
+uncompressed baseline, codec encode+decode seconds, and final score —
+plus the multi-process model-exchange route (the SPMDTrainer collective
+the distributed job's psMessages-equivalent traffic rides) measured the
+same way. `--smoke` is the CI mode: a small stream, the codec sections
+only, and a NONZERO EXIT if an int8 run fails the >= 3.5x bytes-on-wire
+reduction bar or drifts past the convergence envelope.
+
 Usage: python benchmarks/protocol_comparison.py [--records N]
+           [--codec none|fp16|int8|topk|sweep] [--smoke]
 Prints ONE JSON line: {"config": "protocol_comparison", ...}.
 """
 
@@ -58,8 +69,23 @@ SPMD_PROTOCOLS = (
 )
 
 
+def _codec_seconds(job) -> float:
+    """Total transport-codec encode+decode time across every node."""
+    total = 0.0
+    for hub in job.hub_manager.hubs.values():
+        c = getattr(hub.node, "codec", None)
+        if c is not None:
+            total += c.encode_seconds + c.decode_seconds
+    for spoke in job.spokes:
+        for net in spoke.nets.values():
+            c = getattr(net.node, "codec", None)
+            if c is not None:
+                total += c.encode_seconds + c.decode_seconds
+    return total
+
+
 def run_one(protocol: str, x, y, parallelism: int, batch: int,
-            engine: str = "host"):
+            engine: str = "host", codec: str = "none"):
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -82,6 +108,8 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         },
         "trainingConfiguration": {"protocol": protocol, "syncEvery": 4},
     }
+    if codec != "none":
+        create["trainingConfiguration"]["comm"] = {"codec": codec}
     if engine == "spmd":
         create["trainingConfiguration"]["engine"] = "spmd"
         create["trainingConfiguration"]["stageChain"] = 4
@@ -101,12 +129,117 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "score": round(stats.score, 4),
         "fitted": stats.fitted,
         "bytes_shipped": stats.bytes_shipped,
+        "bytes_on_wire": stats.bytes_on_wire,
         "models_shipped": stats.models_shipped,
         "num_of_blocks": stats.num_of_blocks,
     }
+    if codec != "none":
+        out["codec_seconds"] = round(_codec_seconds(job), 4)
     if job.spmd_bridges:
         [bridge] = job.spmd_bridges.values()
         out["bytes_physical"] = bridge.trainer.collective_bytes_physical()
+    return out
+
+
+# codecs swept by --codec sweep, and the host protocols the codec section
+# compares (the model-shipping protocols; GM/FGM traffic is mostly votes)
+CODEC_SWEEP = ("none", "fp16", "int8", "topk")
+CODEC_PROTOCOLS = ("Asynchronous", "Synchronous", "EASGD", "GM")
+
+
+def run_codec_comparison(codecs, records, parallelism, batch,
+                         protocols=CODEC_PROTOCOLS, dim=256):
+    """Sweep transport codecs over a params-dominated stream: per
+    (protocol, codec) bytes-on-wire, wire reduction vs the uncompressed
+    run, codec CPU seconds, throughput and final score."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    w = np.random.RandomState(43).randn(dim)
+    x = rng.randn(records, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    out = {}
+    for protocol in protocols:
+        rows = {}
+        for codec in codecs:
+            r = run_one(protocol, x, y, parallelism, batch, codec=codec)
+            rows[codec] = r
+        base = max(rows.get("none", {}).get("bytes_on_wire", 0), 1)
+        for codec, r in rows.items():
+            if codec != "none":
+                r["wire_reduction_vs_none"] = round(
+                    base / max(r["bytes_on_wire"], 1), 2
+                )
+                r["score_delta_vs_none"] = round(
+                    r["score"] - rows["none"]["score"], 4
+                )
+        out[protocol] = rows
+    return out
+
+
+def run_distributed_route(codecs, dim=256, steps=24, batch=32):
+    """The multi-process model-exchange route: the SPMDTrainer collective
+    sync that carries the distributed job's hub<->spoke traffic (the role
+    of the reference's psMessages Kafka loop). Measures bytes-on-wire per
+    codec on an 8-worker mesh and the parameter drift vs uncompressed."""
+    import numpy as np
+
+    from omldm_tpu.api.requests import LearnerSpec, TrainingConfiguration
+    from omldm_tpu.parallel.mesh import make_mesh
+    from omldm_tpu.parallel.spmd import SPMDTrainer
+
+    mesh = make_mesh(dp=8, hub=1)
+    w = np.random.RandomState(44).randn(dim)
+    r = np.random.RandomState(5)
+    batches = []
+    for _ in range(steps):
+        x = r.randn(8, batch, dim).astype(np.float32)
+        batches.append((x, (x @ w > 0).astype(np.float32),
+                        np.ones((8, batch), np.float32)))
+
+    def run(codec):
+        extra = {"syncEvery": 4}
+        if codec != "none":
+            extra["comm"] = {"codec": codec}
+        t = SPMDTrainer(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=dim,
+            protocol="Synchronous", mesh=mesh,
+            training_configuration=TrainingConfiguration(
+                protocol="Synchronous", extra=extra
+            ),
+        )
+        t0 = time.perf_counter()
+        for x, y, m in batches:
+            t.step(x, y, m)
+        elapsed = time.perf_counter() - t0
+        return t, elapsed
+
+    out = {}
+    base_t, base_s = run("none")
+    base_wire = base_t.bytes_on_wire()
+    base_flat = base_t.global_flat_params()
+    out["none"] = {
+        "bytes_on_wire": base_wire,
+        "bytes_shipped": base_t.bytes_shipped(),
+        "sync_seconds": round(base_s, 3),
+    }
+    for codec in codecs:
+        if codec in ("none", "topk"):
+            continue  # topk is host-plane only (dense allreduce operands)
+        t, secs = run(codec)
+        drift = float(
+            np.linalg.norm(t.global_flat_params() - base_flat)
+            / max(np.linalg.norm(base_flat), 1e-9)
+        )
+        out[codec] = {
+            "bytes_on_wire": t.bytes_on_wire(),
+            "wire_reduction_vs_none": round(
+                base_wire / max(t.bytes_on_wire(), 1), 2
+            ),
+            "param_drift_rel": round(drift, 4),
+            "sync_seconds": round(secs, 3),
+        }
     return out
 
 
@@ -115,6 +248,15 @@ def main() -> None:
     ap.add_argument("--records", type=int, default=50_000)
     ap.add_argument("--parallelism", type=int, default=16)
     ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument(
+        "--codec", default="none",
+        choices=("none", "fp16", "int8", "topk", "sweep"),
+        help="transport codec section: one codec (vs none) or sweep",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CI mode: small stream, codec sections only, hard asserts",
+    )
     args = ap.parse_args()
 
     import os
@@ -134,6 +276,63 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
 
     import numpy as np
+
+    codecs = (
+        CODEC_SWEEP if args.codec == "sweep"
+        else ("none", args.codec) if args.codec != "none"
+        else ()
+    )
+
+    if args.smoke:
+        # CI gate: the codec path end to end on a small stream, with the
+        # acceptance bars enforced (nonzero exit on regression)
+        records = min(args.records, 6_000)
+        par = min(args.parallelism, 4)
+        sweep = codecs or ("none", "int8")
+        comp = run_codec_comparison(
+            sweep, records, par, min(args.batch, 64),
+            protocols=("Asynchronous", "Synchronous"),
+        )
+        dist = run_distributed_route(sweep, steps=12)
+        failures = []
+        for protocol, rows in comp.items():
+            for codec, r in rows.items():
+                if codec == "int8":
+                    if r["wire_reduction_vs_none"] < 3.5:
+                        failures.append(
+                            f"{protocol}/int8 host wire reduction "
+                            f"{r['wire_reduction_vs_none']}x < 3.5x"
+                        )
+                    if abs(r["score_delta_vs_none"]) > 0.05:
+                        failures.append(
+                            f"{protocol}/int8 score drift "
+                            f"{r['score_delta_vs_none']} > 0.05"
+                        )
+        if "int8" in dist:
+            if dist["int8"]["wire_reduction_vs_none"] < 3.5:
+                failures.append(
+                    "distributed route int8 wire reduction "
+                    f"{dist['int8']['wire_reduction_vs_none']}x < 3.5x"
+                )
+            if dist["int8"]["param_drift_rel"] > 0.05:
+                failures.append(
+                    "distributed route int8 param drift "
+                    f"{dist['int8']['param_drift_rel']} > 0.05"
+                )
+        print(
+            json.dumps(
+                {
+                    "config": "protocol_comparison_smoke",
+                    "records": records,
+                    "codec_comparison": comp,
+                    "distributed_route": dist,
+                    "failures": failures,
+                }
+            )
+        )
+        if failures:
+            sys.exit(1)
+        return
 
     rng = np.random.RandomState(0)
     w = np.random.RandomState(42).randn(28)
@@ -170,6 +369,16 @@ def main() -> None:
             abs(r["score"] - host["score"]), 4
         )
         out_spmd[protocol] = r
+
+    # transport-codec sections (--codec): params-dominated host stream
+    # sweep + the distributed model-exchange route
+    codec_out = {}
+    if codecs:
+        codec_out["codec_comparison"] = run_codec_comparison(
+            codecs, max(args.records // 2, 10_000), args.parallelism,
+            args.batch,
+        )
+        codec_out["distributed_route"] = run_distributed_route(codecs)
     print(
         json.dumps(
             {
@@ -179,6 +388,7 @@ def main() -> None:
                 "records": args.records,
                 "protocols": out,
                 "protocols_spmd": out_spmd,
+                **codec_out,
                 "spmd_basis": (
                     "virtual 8-device CPU mesh: protocol SEMANTICS, score "
                     "parity and traffic accounting — NOT chip throughput "

@@ -1153,8 +1153,13 @@ def main():
         proto = subprocess.run(
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "protocol_comparison.py")],
-            capture_output=True, text=True, timeout=1800,
+                          "protocol_comparison.py"),
+             # sweep the transport codecs too, so every BENCH round
+             # records bytes_on_wire per protocol (comm volume, not just
+             # throughput) in the results JSON; the sweep roughly doubles
+             # the section's work, so the timeout doubles with it
+             "--codec", "sweep"],
+            capture_output=True, text=True, timeout=3600,
             env={**os.environ, "PYTHONPATH": child_path},
         )
         if proto.returncode != 0:

@@ -31,6 +31,15 @@ class Statistics:
     protocol: str = ""
     models_shipped: int = 0
     bytes_shipped: int = 0
+    # bytes that actually crossed the hub<->spoke wire, counted per
+    # MESSAGE at the transport boundary (ship wrappers / Hub.receive):
+    # encoded payload sizes when a codec is configured (runtime.codec),
+    # raw sizes otherwise. With codec none this matches bytes_shipped for
+    # pure model-push traffic, but can differ slightly for protocols
+    # whose control replies are not logically counted (e.g. SSP release
+    # messages) — the wire counter sees every message, the logical one
+    # only the reference's getSize call sites
+    bytes_on_wire: int = 0
     num_of_blocks: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
@@ -43,11 +52,13 @@ class Statistics:
         models_shipped: int = 0,
         bytes_shipped: int = 0,
         num_of_blocks: int = 0,
+        bytes_on_wire: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127)."""
         self.models_shipped += models_shipped
         self.bytes_shipped += bytes_shipped
         self.num_of_blocks += num_of_blocks
+        self.bytes_on_wire += bytes_on_wire
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -86,6 +97,7 @@ class Statistics:
             protocol=self.protocol or other.protocol,
             models_shipped=self.models_shipped + other.models_shipped,
             bytes_shipped=self.bytes_shipped + other.bytes_shipped,
+            bytes_on_wire=self.bytes_on_wire + other.bytes_on_wire,
             num_of_blocks=self.num_of_blocks + other.num_of_blocks,
             fitted=self.fitted + other.fitted,
             mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
@@ -106,6 +118,7 @@ class Statistics:
             "protocol": self.protocol,
             "modelsShipped": self.models_shipped,
             "bytesShipped": self.bytes_shipped,
+            "bytesOnWire": self.bytes_on_wire,
             "numOfBlocks": self.num_of_blocks,
             "fitted": self.fitted,
             "learningCurve": self.learning_curve,

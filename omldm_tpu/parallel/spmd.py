@@ -60,8 +60,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from omldm_tpu.api.requests import LearnerSpec, PreprocessorSpec, TrainingConfiguration
 from omldm_tpu.learners.registry import make_learner
+from omldm_tpu.ops.codec import BYTES_PER_ELEMENT, LEAF_META_BYTES, make_qdq
 from omldm_tpu.preprocessors.registry import make_preprocessor
 from omldm_tpu.parallel.mesh import make_mesh
+from omldm_tpu.runtime.codec import comm_codec_name
 from omldm_tpu.utils import batch_valid_counts
 
 
@@ -141,6 +143,14 @@ class SPMDTrainer:
             )
         default_alpha = 0.5 / max(self.dp, 1)
         self.alpha = float(self.tc.extra.get("alpha", default_alpha))
+        # transport codec (trainingConfiguration.comm.codec): the SPMD twin
+        # of the host plane's runtime.codec — quantize-dequantize at the
+        # collective ship boundary with an error-feedback state leaf, so
+        # every value crossing the (emulated) wire is codec-representable.
+        # ``topk`` is host-plane only (make_qdq raises: the allreduce needs
+        # dense operands); ``none`` compiles the exact pre-codec step.
+        self.codec_name = comm_codec_name(self.tc)
+        self._qdq = make_qdq(self.codec_name)
 
         # feature dims through the prep chain
         d = dim
@@ -223,7 +233,7 @@ class SPMDTrainer:
         )
         zero = stack(np.zeros((self.dp,), np.float32))
         izero = stack(np.zeros((self.dp,), np.int32))
-        return {
+        state = {
             "params": params,
             "preps": preps,
             "est": vec.copy(),     # estimate at last sync (GM/FGM/async base)
@@ -240,6 +250,14 @@ class SPMDTrainer:
             # executed (physical collective rounds; 0 for other protocols)
             "fold_rounds": izero.copy(),
         }
+        if self._qdq is not None:
+            # per-worker error-feedback residual for the transport codec:
+            # the quantization error of each shipped vector, added back to
+            # the next one shipped (1-bit-SGD-style EF). Only present when
+            # a codec is configured, so codec-none state trees — and their
+            # checkpoints — are unchanged.
+            state["ef"] = stack(np.zeros((self.dp, self.flat_size), np.float32))
+        return state
 
     # --- the per-shard step ---
 
@@ -276,6 +294,8 @@ class SPMDTrainer:
 
         sparse = getattr(learner, "sparse", False)
 
+        qdq = self._qdq  # transport codec QDQ kernel (None = raw fp32)
+
         def step_fn(state, x, y, mask):
             # per-shard views: state leaves [1,1,...]; batch [1,B,D] dense
             # or ([1,B,K] idx, [1,B,K] val) padded-COO. Inputs may arrive
@@ -300,6 +320,7 @@ class SPMDTrainer:
             cum_loss = _sq(state["cum_loss"])
             clock = _sq(state["clock"])
             fold_rounds = _sq(state["fold_rounds"])
+            ef = _sq(state["ef"]) if qdq is not None else None
 
             old_params = params
             old_preps = prep_states
@@ -323,27 +344,60 @@ class SPMDTrainer:
             accepted = jnp.sum(mask) * 0.0 + 1.0
 
             if protocol == "Synchronous":
-                def do_sync(f, e, c, s):
-                    g = self._ps_allreduce(f)
-                    return g, g, c, s + 1
+                if qdq is None:
+                    def do_sync(f, e, c, s):
+                        g = self._ps_allreduce(f)
+                        return g, g, c, s + 1
 
-                flat, est, center, syncs = jax.lax.cond(
-                    at_cadence, do_sync,
-                    lambda f, e, c, s: (f, e, c, s),
-                    flat, est, center, syncs,
-                )
+                    flat, est, center, syncs = jax.lax.cond(
+                        at_cadence, do_sync,
+                        lambda f, e, c, s: (f, e, c, s),
+                        flat, est, center, syncs,
+                    )
+                else:
+                    # codec ship boundary: the worker's contribution is
+                    # quantized (with error feedback) before entering the
+                    # collective, and the reassembled global is quantized
+                    # again for the downlink — both wire legs carry only
+                    # codec-representable values
+                    def do_sync(f, e, c, s, r):
+                        snd = f + r
+                        t = qdq(snd)
+                        g = qdq(self._ps_allreduce(t))
+                        return g, g, c, s + 1, snd - t
+
+                    flat, est, center, syncs, ef = jax.lax.cond(
+                        at_cadence, do_sync,
+                        lambda f, e, c, s, r: (f, e, c, s, r),
+                        flat, est, center, syncs, ef,
+                    )
             elif protocol == "EASGD":
-                def do_sync(f, e, c, s):
-                    mean_x = self._ps_allreduce(f)
-                    new_c = c + alpha * n_workers * (mean_x - c)
-                    new_f = f - alpha * (f - c)
-                    return new_f, e, new_c, s + 1
+                if qdq is None:
+                    def do_sync(f, e, c, s):
+                        mean_x = self._ps_allreduce(f)
+                        new_c = c + alpha * n_workers * (mean_x - c)
+                        new_f = f - alpha * (f - c)
+                        return new_f, e, new_c, s + 1
 
-                flat, est, center, syncs = jax.lax.cond(
-                    at_cadence, do_sync,
-                    lambda f, e, c, s: (f, e, c, s),
-                    flat, est, center, syncs,
-                )
+                    flat, est, center, syncs = jax.lax.cond(
+                        at_cadence, do_sync,
+                        lambda f, e, c, s: (f, e, c, s),
+                        flat, est, center, syncs,
+                    )
+                else:
+                    def do_sync(f, e, c, s, r):
+                        snd = f + r
+                        t = qdq(snd)
+                        mean_x = qdq(self._ps_allreduce(t))
+                        new_c = c + alpha * n_workers * (mean_x - c)
+                        new_f = f - alpha * (f - c)
+                        return new_f, e, new_c, s + 1, snd - t
+
+                    flat, est, center, syncs, ef = jax.lax.cond(
+                        at_cadence, do_sync,
+                        lambda f, e, c, s, r: (f, e, c, s, r),
+                        flat, est, center, syncs, ef,
+                    )
             elif protocol in ("GM", "FGM"):
                 drift2 = jnp.sum((flat - est) ** 2)
                 if protocol == "GM":
@@ -357,15 +411,28 @@ class SPMDTrainer:
                     psi = jax.lax.psum(drift2 - threshold**2, "dp")
                     fire = psi >= 0.0
 
-                def do_sync(f, e, c, s):
-                    g = self._ps_allreduce(f)
-                    return g, g, c, s + 1
+                if qdq is None:
+                    def do_sync(f, e, c, s):
+                        g = self._ps_allreduce(f)
+                        return g, g, c, s + 1
 
-                flat, est, center, syncs = jax.lax.cond(
-                    jnp.logical_and(at_cadence, fire), do_sync,
-                    lambda f, e, c, s: (f, e, c, s),
-                    flat, est, center, syncs,
-                )
+                    flat, est, center, syncs = jax.lax.cond(
+                        jnp.logical_and(at_cadence, fire), do_sync,
+                        lambda f, e, c, s: (f, e, c, s),
+                        flat, est, center, syncs,
+                    )
+                else:
+                    def do_sync(f, e, c, s, r):
+                        snd = f + r
+                        t = qdq(snd)
+                        g = qdq(self._ps_allreduce(t))
+                        return g, g, c, s + 1, snd - t
+
+                    flat, est, center, syncs, ef = jax.lax.cond(
+                        jnp.logical_and(at_cadence, fire), do_sync,
+                        lambda f, e, c, s, r: (f, e, c, s, r),
+                        flat, est, center, syncs, ef,
+                    )
             else:  # Asynchronous / SSP: event-driven progress + PS folds
                 # progress is per-worker: a worker only advances its clock
                 # on ticks where it has data; under SSP a worker whose
@@ -407,15 +474,32 @@ class SPMDTrainer:
                 )
                 contrib = jnp.where(my_turn, flat - est, jnp.zeros_like(flat))
 
-                def do_fold(c, fr):
-                    # shared global accumulates mean deltas (PS fold),
-                    # routed through the hub shards like every collective
-                    return c + self._ps_allreduce(contrib), fr + 1
+                if qdq is None:
+                    def do_fold(c, fr):
+                        # shared global accumulates mean deltas (PS fold),
+                        # routed through the hub shards like every collective
+                        return c + self._ps_allreduce(contrib), fr + 1
 
-                center, fold_rounds = jax.lax.cond(
-                    any_fold, do_fold, lambda c, fr: (c, fr),
-                    center, fold_rounds,
-                )
+                    center, fold_rounds = jax.lax.cond(
+                        any_fold, do_fold, lambda c, fr: (c, fr),
+                        center, fold_rounds,
+                    )
+                else:
+                    def do_fold(c, fr, r):
+                        # only folding workers ship (and spend) their EF
+                        # residual; bystanders contribute exact zeros and
+                        # keep their residual for their own next fold
+                        s = jnp.where(
+                            my_turn, contrib + r, jnp.zeros_like(contrib)
+                        )
+                        t = qdq(s)
+                        new_c = c + qdq(self._ps_allreduce(t))
+                        return new_c, fr + 1, jnp.where(my_turn, s - t, r)
+
+                    center, fold_rounds, ef = jax.lax.cond(
+                        any_fold, do_fold, lambda c, fr, r: (c, fr, r),
+                        center, fold_rounds, ef,
+                    )
                 flat = jnp.where(my_turn, center, flat)
                 est = jnp.where(my_turn, center, est)
                 syncs = syncs + my_turn.astype(jnp.int32)
@@ -441,6 +525,8 @@ class SPMDTrainer:
                 "accepted": _unsq(accepted),
                 "fold_rounds": _unsq(fold_rounds),
             }
+            if qdq is not None:
+                new_state["ef"] = _unsq(ef)
             return new_state, _unsq(loss)
 
         return step_fn
@@ -590,11 +676,18 @@ class SPMDTrainer:
     def protocol_traffic_bytes(
         protocol: str, dp: int, flat_size: int,
         syncs_sum: int, syncs00: int, steps: int,
+        codec: str = "none",
     ) -> Tuple[int, int]:
         """(sync_count, bytesShipped) from raw counters — the ONE payload
         formula, shared with the distributed job's merged report so the
-        two accountings can never diverge."""
-        param_bytes = 2 * flat_size * 4
+        two accountings can never diverge. ``codec`` prices each param
+        sync at the transport codec's wire width (ops.codec): pass
+        ``"none"`` (the default) for the LOGICAL fp32 accounting, the
+        pipeline's configured codec for bytes-on-wire. Scalar control
+        channels (votes, clocks) are never compressed."""
+        per_el = BYTES_PER_ELEMENT[codec]
+        meta = LEAF_META_BYTES[codec]
+        param_bytes = 2 * (int(flat_size * per_el) + meta)
         if protocol in ("Asynchronous", "SSP"):
             sync_count = syncs_sum
             total = syncs_sum * param_bytes
@@ -630,6 +723,21 @@ class SPMDTrainer:
         _, total = self.protocol_traffic_bytes(
             self.protocol, self.dp, self.flat_size,
             int(syncs[:, 0].sum()), int(syncs[0, 0]), steps,
+        )
+        return total
+
+    def bytes_on_wire(self) -> int:
+        """bytesShipped priced at the configured transport codec's wire
+        width — what the sync traffic would cost a deployment whose
+        inter-host links carry the quantized representation (the values
+        crossing the collective are already codec-representable via the
+        in-step QDQ). Equal to :meth:`bytes_shipped` with codec ``none``."""
+        syncs = np.asarray(jax.device_get(self.state["syncs"]))
+        steps = int(np.asarray(jax.device_get(self.state["step"]))[0, 0])
+        _, total = self.protocol_traffic_bytes(
+            self.protocol, self.dp, self.flat_size,
+            int(syncs[:, 0].sum()), int(syncs[0, 0]), steps,
+            codec=self.codec_name,
         )
         return total
 

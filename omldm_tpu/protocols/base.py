@@ -26,6 +26,7 @@ import numpy as np
 from omldm_tpu.api.requests import TrainingConfiguration
 from omldm_tpu.api.stats import Statistics
 from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.runtime.codec import make_transport_codec
 from omldm_tpu.runtime.messages import payload_size
 
 # send(op: str, payload, hub_id: int) -> None           (worker -> hub)
@@ -53,6 +54,30 @@ class WorkerNode:
         self.config = config
         self.send = send
         self.paused = False  # toggle() support (FlinkSpoke.scala:130)
+        # transport codec (trainingConfiguration.comm.codec): when
+        # configured, every outgoing payload is encoded ONCE at this ship
+        # boundary (error feedback lives in the codec, keyed per hub
+        # stream) and incoming hub payloads decode in deliver(). With the
+        # default ``none`` no codec object exists and ``self.send`` stays
+        # the raw router callable — bit-identical to the pre-codec path.
+        self._send_raw = send
+        self.codec = make_transport_codec(config)
+        if self.codec is not None:
+            self.send = self._send_encoded
+
+    def _send_encoded(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        payload = self.codec.encode(
+            payload, stream=f"w{self.worker_id}>h{hub_id}"
+        )
+        self._send_raw(op, payload, hub_id)
+
+    def deliver(self, op: str, payload: Any, hub_id: int = 0) -> None:
+        """Receive boundary: decode transport-encoded payloads exactly
+        once, then hand the raw payload to :meth:`receive`. The runtime
+        (Spoke.receive_from_hub) routes hub messages through here."""
+        if self.codec is not None:
+            payload = self.codec.decode(payload)
+        self.receive(op, payload, hub_id)
 
     def on_start(self) -> None:
         """Called once after creation (e.g. async workers pull the model)."""
@@ -117,10 +142,39 @@ class HubNode:
         self.n_workers = n_workers
         self.n_hubs = n_hubs
         self.config = config
-        self.reply = reply
-        self.broadcast = broadcast
         self.stats = Statistics(pipeline=network_id, protocol=config.protocol)
         self._curve_buffer: list = []
+        # ship hooks: every hub->worker payload leaves through these two
+        # wrappers, which (a) encode it ONCE when a transport codec is
+        # configured (trainingConfiguration.comm.codec) and (b) count the
+        # bytes that actually cross the wire into ``bytes_on_wire`` —
+        # encoded size when compressing, the raw payload size otherwise.
+        # Logical accounting (bytesShipped) stays at the protocol call
+        # sites (count_shipped), preserving the reference's getSize
+        # semantics unchanged.
+        self._reply_raw = reply
+        self._broadcast_raw = broadcast
+        self.codec = make_transport_codec(config)
+        self.reply = self._reply_ship
+        self.broadcast = self._broadcast_ship
+
+    def _reply_ship(self, worker_id: int, op: str, payload: Any) -> None:
+        if self.codec is not None:
+            payload = self.codec.encode(
+                payload, stream=f"h{self.hub_id}>w{worker_id}"
+            )
+        self.stats.update_stats(bytes_on_wire=payload_size(payload))
+        self._reply_raw(worker_id, op, payload)
+
+    def _broadcast_ship(self, op: str, payload: Any) -> None:
+        if self.codec is not None:
+            # one encode per broadcast: compression happens once at the
+            # ship boundary, every destination decodes the same bytes
+            payload = self.codec.encode(payload, stream=f"h{self.hub_id}>*")
+        self.stats.update_stats(
+            bytes_on_wire=payload_size(payload) * self.n_workers
+        )
+        self._broadcast_raw(op, payload)
 
     # --- statistics helpers (byte accounting at the send sites, mirroring
     # FlinkHub.scala:118-127 / FlinkNetwork getSize calls) ---

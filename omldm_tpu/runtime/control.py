@@ -41,7 +41,7 @@ class PipelineManager:
             err = self._validate_sparse(request)
             if err:
                 return err
-            return None
+            return self._validate_codec(request)
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
                 return f"pipeline {request.id} does not exist"
@@ -51,6 +51,7 @@ class PipelineManager:
                 err = self._validate_sparse(request)
                 if err:
                     return err
+                return self._validate_codec(request)
             return None
         return f"unknown request type {request.request}"
 
@@ -75,6 +76,28 @@ class PipelineManager:
             )
         if request.preprocessors:
             return "sparse learners do not take preprocessors"
+        return None
+
+    @staticmethod
+    def _validate_codec(request: Request) -> Optional[str]:
+        """Transport-codec config must be deployable for the same reason
+        as the sparse gate: an unknown codec name (or topk on the
+        collective engine, whose allreduce needs dense operands) would
+        raise at node construction and kill the job instead of dropping
+        the one bad request."""
+        from omldm_tpu.runtime.codec import comm_codec_name
+
+        tc = request.training_configuration
+        try:
+            name = comm_codec_name(tc)
+        except ValueError as exc:
+            return str(exc)
+        # engine matching must mirror spmd_engine_requested (case-blind),
+        # or a casing variant slips past the gate and raises at deploy
+        if name == "topk" and str(
+            tc.extra.get("engine", "")
+        ).lower() == "spmd":
+            return "topk codec is host-plane only (SPMD allreduce needs dense operands)"
         return None
 
     def admit(self, request: Request) -> bool:

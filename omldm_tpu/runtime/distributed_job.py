@@ -1003,6 +1003,13 @@ class DistributedStreamJob:
         sync_count, total_bytes = t.protocol_traffic_bytes(
             t.protocol, t.dp, t.flat_size, syncs_sum, syncs00, steps
         )
+        # same counters priced at the configured transport codec's wire
+        # width — the multi-process model-exchange route's bytes-on-wire
+        # (the role of the reference's psMessages traffic accounting)
+        _, wire_bytes = t.protocol_traffic_bytes(
+            t.protocol, t.dp, t.flat_size, syncs_sum, syncs00, steps,
+            codec=t.codec_name,
+        )
         reduced = self._collective_reduce(
             [float(t.fitted), float(len(p.test_set)), float(p.pend_n)], "sum"
         )
@@ -1011,6 +1018,7 @@ class DistributedStreamJob:
             protocol=t.protocol,
             models_shipped=sync_count * t.dp,
             bytes_shipped=int(total_bytes),
+            bytes_on_wire=int(wire_bytes),
             num_of_blocks=sync_count,
             fitted=int(round(reduced[0])),
             learning_curve=[l for l, _ in p.curve],

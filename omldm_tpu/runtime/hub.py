@@ -21,6 +21,7 @@ from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.centralized import CentralizedMLServer
 from omldm_tpu.protocols.registry import make_hub_node, resolve_protocol
 from omldm_tpu.runtime.databuffers import DataSet
+from omldm_tpu.runtime.messages import payload_size
 
 
 class Hub:
@@ -69,6 +70,13 @@ class Hub:
             )
 
     def receive(self, worker_id: int, op: str, payload: Any) -> None:
+        # transport boundary: count the bytes that actually crossed the
+        # wire (encoded size when the worker compressed, raw size
+        # otherwise) and decode ONCE, so protocol logic and its logical
+        # bytesShipped accounting never see encoded leaves
+        self.node.stats.update_stats(bytes_on_wire=payload_size(payload))
+        if self.node.codec is not None:
+            payload = self.node.codec.decode(payload)
         self.node.receive(worker_id, op, payload)
 
     def statistics(self) -> Statistics:

@@ -377,8 +377,12 @@ class StreamJob:
                     dst.pipeline.state = state
                     # drift-monitoring workers re-anchor their baseline at
                     # the seeded model (a stale init-time estimate would
-                    # register the seed itself as drift and fire a sync)
+                    # register the seed itself as drift and fire a sync);
+                    # transport-codec state (EF residuals, topk bases)
+                    # likewise restarts from the replaced model
                     dst.node.on_model_seeded()
+                    if dst.node.codec is not None:
+                        dst.node.codec.reset_streams()
         else:
             survivors, retired = self.spokes[:n_new], self.spokes[n_new:]
             self.config.parallelism = n_new

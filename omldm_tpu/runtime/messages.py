@@ -49,14 +49,18 @@ class NodeId:
 
 
 def payload_size(payload: Any) -> int:
-    """Approximate serialized byte size of a message payload, mirroring
-    ``CountableSerial.getSize`` (FlinkMessage.scala:16-23). Arrays count
-    their buffer size; scalars 8 bytes; containers recurse."""
+    """Serialized byte size of a message payload, mirroring
+    ``CountableSerial.getSize`` (FlinkMessage.scala:16-23). Array leaves
+    count their EXACT buffer size — numpy/jax arrays and numpy scalars
+    report ``nbytes``, never the generic 8-byte scalar estimate — and
+    transport-encoded leaves (runtime.codec.EncodedLeaf) report their
+    wire size through the same ``nbytes`` contract. Python scalars count
+    8 bytes; containers recurse."""
     if payload is None:
         return 0
     if isinstance(payload, np.ndarray):
         return int(payload.nbytes)
-    if hasattr(payload, "nbytes"):  # jax arrays
+    if hasattr(payload, "nbytes"):  # jax arrays, numpy scalars, EncodedLeaf
         return int(payload.nbytes)
     if isinstance(payload, (list, tuple)):
         return sum(payload_size(p) for p in payload)

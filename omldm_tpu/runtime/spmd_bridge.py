@@ -747,6 +747,7 @@ class SPMDBridge:
             protocol=self.request.training_configuration.protocol,
             models_shipped=self.trainer.sync_count() * self.dp,
             bytes_shipped=self.trainer.bytes_shipped(),
+            bytes_on_wire=self.trainer.bytes_on_wire(),
             num_of_blocks=self.trainer.sync_count(),
             fitted=self.trainer.fitted,
             learning_curve=[l for l, _ in curve],

@@ -542,7 +542,8 @@ class Spoke:
         net = self.nets.get(network_id)
         if net is None:
             return
-        net.node.receive(op, payload, hub_id)
+        # deliver() is the worker-side decode boundary (transport codec)
+        net.node.deliver(op, payload, hub_id)
         # cooperative multi-pipeline fairness: every hub RPC for one net
         # TOGGLES the others (FlinkSpoke.scala:127-131) — alternating
         # pause/resume yields the spoke between hosted pipelines; a net
@@ -634,6 +635,10 @@ class Spoke:
                         if snet.batcher.full:
                             snet.flush_batch()
             snet.pipeline.merge_from([rnet.pipeline])
+            # the merge replaced the model wholesale: EF residuals and
+            # topk bases computed against the pre-merge model are stale
+            if snet.node.codec is not None:
+                snet.node.codec.reset_streams()
             # holdout windows interleave (keep-newest overflow), the same
             # merge the reference's rescale uses (CommonUtils.scala:36-48)
             snet.test_set.merge([rnet.test_set])

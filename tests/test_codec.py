@@ -1,0 +1,264 @@
+"""Transport codec unit tests: kernel round-trip bounds, error-feedback
+behavior, payload walking, wire-byte accounting, and config plumbing."""
+
+import numpy as np
+import pytest
+
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.ops.codec import (
+    fp16_decode,
+    fp16_encode,
+    int8_affine_decode,
+    int8_affine_encode,
+    int8_quantization_step,
+    topk_decode,
+    topk_encode,
+)
+from omldm_tpu.runtime.codec import (
+    EncodedLeaf,
+    TransportCodec,
+    comm_codec_name,
+    decode_payload,
+    make_transport_codec,
+)
+from omldm_tpu.runtime.messages import payload_size
+
+
+def tc_for(codec, **comm):
+    return TrainingConfiguration(
+        protocol="Asynchronous", extra={"comm": {"codec": codec, **comm}}
+    )
+
+
+SHAPES = [(17,), (257,), (64, 3), (1024,)]
+
+
+class TestKernelRoundTrips:
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_int8_affine_within_one_grid_step(self, shape):
+        rng = np.random.RandomState(0)
+        x = (rng.randn(*shape) * rng.uniform(0.1, 50)).astype(np.float32)
+        q, scale, zero = int8_affine_encode(x)
+        dec = int8_affine_decode(q, scale, zero).reshape(shape)
+        bound = int8_quantization_step(x) + 1e-6
+        assert np.max(np.abs(dec - x)) <= bound
+
+    def test_int8_constant_vector_exact(self):
+        x = np.full((100,), 3.25, np.float32)
+        q, scale, zero = int8_affine_encode(x)
+        dec = int8_affine_decode(q, scale, zero)
+        np.testing.assert_allclose(dec, x, atol=1e-6)
+
+    @pytest.mark.parametrize("shape", SHAPES)
+    def test_fp16_round_trip_relative_bound(self, shape):
+        rng = np.random.RandomState(1)
+        x = rng.randn(*shape).astype(np.float32)
+        dec = fp16_decode(fp16_encode(x)).reshape(shape)
+        # fp16 has a 10-bit mantissa: relative error < 2^-10 per element
+        assert np.max(np.abs(dec - x) / np.maximum(np.abs(x), 1e-3)) < 2**-10
+
+    def test_topk_keeps_largest_and_scatter_inverts(self):
+        x = np.zeros((64,), np.float32)
+        hot = [3, 17, 40, 63]
+        x[hot] = [5.0, -7.0, 2.0, 1.5]
+        idx, val = topk_encode(x, 3)
+        assert set(idx.tolist()) == {3, 17, 40}  # largest magnitudes
+        dec = topk_decode(idx, val, 64)
+        np.testing.assert_allclose(dec[idx], x[idx])
+        assert dec[63] == 0.0  # dropped mass stays for error feedback
+
+    def test_topk_k_covers_everything(self):
+        x = np.arange(10, dtype=np.float32)
+        idx, val = topk_encode(x, 100)
+        np.testing.assert_allclose(topk_decode(idx, val, 10), x)
+
+
+class TestErrorFeedback:
+    def test_int8_residual_drains_on_constant_stream(self):
+        """Shipping the SAME vector repeatedly must not accumulate
+        transport error: with error feedback the time-averaged decode
+        converges to the true value and the residual stays bounded by
+        one quantization step."""
+        codec = TransportCodec("int8", min_leaf_size=4)
+        rng = np.random.RandomState(2)
+        x = rng.randn(257).astype(np.float32)
+        decs = []
+        for _ in range(64):
+            leaf = codec.encode({"params": x}, stream="w0>h0")["params"]
+            decs.append(decode_payload({"params": leaf})["params"])
+        step = int8_quantization_step(x)
+        resid = codec._residual[("w0>h0", ".params")]
+        assert np.max(np.abs(resid)) <= 2 * step + 1e-6
+        avg = np.mean(decs, axis=0)
+        # time-averaged transport error well below one grid step
+        assert np.max(np.abs(avg - x)) < step / 2
+
+    def test_fp16_residual_drains_on_constant_stream(self):
+        codec = TransportCodec("fp16", min_leaf_size=4)
+        x = (np.random.RandomState(3).randn(64) * 100).astype(np.float32)
+        decs = []
+        for _ in range(32):
+            leaf = codec.encode({"params": x}, stream="s")["params"]
+            decs.append(decode_payload({"params": leaf})["params"])
+        avg = np.mean(decs, axis=0)
+        assert np.max(np.abs(avg - x) / np.maximum(np.abs(x), 1e-3)) < 2**-12
+
+    def test_topk_converges_on_constant_stream(self):
+        """Repeated syncs of a static vector ship the missed mass via the
+        residual until the receiver base equals the vector exactly."""
+        tx = TransportCodec("topk", top_k=8, min_leaf_size=4)
+        rx = TransportCodec("topk", top_k=8, min_leaf_size=4)
+        x = np.random.RandomState(4).randn(64).astype(np.float32)
+        dec = None
+        for _ in range(64 // 8 + 2):
+            leaf = tx.encode({"params": x}, stream="w0>h0")["params"]
+            dec = rx.decode({"params": leaf})["params"]
+        np.testing.assert_allclose(dec, x, atol=1e-5)
+
+    def test_topk_gapped_receiver_recovers_at_anchor(self):
+        """A receiver that misses deltas (or joins a live stream late)
+        desyncs its base — the periodic stream anchor (sender restarts
+        from a zero base at seq 0) must bring it back within one cycle."""
+        tx = TransportCodec("topk", top_k=16, min_leaf_size=4,
+                            anchor_every=8)
+        rx = TransportCodec("topk", top_k=16, min_leaf_size=4,
+                            anchor_every=8)
+        rng = np.random.RandomState(10)
+        x = rng.randn(64).astype(np.float32)
+        dec = None
+        for i in range(24):
+            if i < 16:  # drift during the first two cycles, then settle
+                x = x + rng.randn(64).astype(np.float32) * 0.01
+            leaf = tx.encode({"params": x}, stream="h0>*")["params"]
+            if 3 <= i <= 5:
+                continue  # receiver misses these messages entirely
+            dec = rx.decode({"params": leaf})["params"]
+        # 24 messages = 3 anchor cycles; the gap sat in cycle 0, and the
+        # final cycle re-shipped the settled vector from a fresh base
+        assert np.max(np.abs(dec - x)) < 1e-4
+
+    def test_reset_streams_drops_all_state(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        codec.encode({"params": np.ones((32,), np.float32)}, stream="s")
+        assert codec._residual
+        codec.reset_streams()
+        assert not codec._residual and not codec._tx_base
+
+    def test_streams_are_independent(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        a = np.random.RandomState(5).randn(32).astype(np.float32)
+        b = (np.random.RandomState(6).randn(32) * 100).astype(np.float32)
+        codec.encode({"params": a}, stream="w0>h0")
+        codec.encode({"params": b}, stream="w0>h1")
+        assert ("w0>h0", ".params") in codec._residual
+        assert ("w0>h1", ".params") in codec._residual
+        ra = codec._residual[("w0>h0", ".params")]
+        assert np.max(np.abs(ra)) <= 2 * int8_quantization_step(a) + 1e-6
+
+
+class TestPayloadWalking:
+    def test_non_array_payloads_pass_through(self):
+        codec = TransportCodec("int8")
+        payload = {"violation": True, "curve": [(0.5, 10)], "fitted": 3}
+        enc = codec.encode(payload, stream="s")
+        assert enc["violation"] is True
+        assert enc["fitted"] == 3
+        assert list(enc["curve"]) == [(0.5, 10)]
+
+    def test_small_and_int_leaves_stay_raw(self):
+        codec = TransportCodec("int8", min_leaf_size=16)
+        small = np.ones((4,), np.float32)
+        ints = np.arange(64, dtype=np.int32)
+        enc = codec.encode({"a": small, "b": ints}, stream="s")
+        assert enc["a"] is small
+        assert enc["b"] is ints
+
+    def test_bare_array_payload(self):
+        codec = TransportCodec("fp16", min_leaf_size=4)
+        x = np.random.RandomState(7).randn(32).astype(np.float32)
+        enc = codec.encode(x, stream="s")
+        assert isinstance(enc, EncodedLeaf)
+        dec = decode_payload(enc)
+        assert dec.shape == x.shape and dec.dtype == np.float32
+
+    def test_nested_structures_round_trip(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.random.RandomState(8).randn(40).astype(np.float32)
+        payload = {"params": x, "extra": {"clock": 3}, "pair": [x * 2, "tag"]}
+        dec = decode_payload(codec.encode(payload, stream="s"))
+        step = int8_quantization_step(x)
+        assert np.max(np.abs(dec["params"] - x)) <= step + 1e-6
+        assert dec["extra"]["clock"] == 3
+        assert dec["pair"][1] == "tag"
+
+    def test_stateless_decode_rejects_topk(self):
+        codec = TransportCodec("topk", top_k=4, min_leaf_size=4)
+        enc = codec.encode(np.ones((32,), np.float32), stream="s")
+        with pytest.raises(ValueError, match="stateful"):
+            decode_payload(enc)
+
+
+class TestWireAccounting:
+    def test_int8_wire_size(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.zeros((257,), np.float32)
+        enc = codec.encode({"params": x}, stream="s")
+        leaf = enc["params"]
+        assert leaf.nbytes == 257 + 8  # 1 B/element + scale/zero meta
+        assert leaf.logical_nbytes == 257 * 4
+        assert payload_size(enc) == 257 + 8
+        assert payload_size({"params": x}) == 257 * 4
+
+    def test_fp16_wire_size(self):
+        codec = TransportCodec("fp16", min_leaf_size=4)
+        enc = codec.encode(np.zeros((100,), np.float32), stream="s")
+        assert enc.nbytes == 200
+        assert payload_size(enc) == 200
+
+    def test_topk_wire_size(self):
+        codec = TransportCodec("topk", top_k=16, min_leaf_size=4)
+        enc = codec.encode(np.ones((256,), np.float32), stream="s")
+        assert enc.nbytes == 16 * 8  # int32 idx + float32 val per entry
+
+    def test_int8_reduction_beats_3_5x_on_params_vector(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.random.RandomState(9).randn(257).astype(np.float32)
+        enc = codec.encode({"params": x}, stream="s")
+        assert payload_size({"params": x}) / payload_size(enc) >= 3.5
+
+    def test_instrumentation_counters(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.zeros((64,), np.float32)
+        codec.encode({"params": x}, stream="s")
+        assert codec.leaves_encoded == 1
+        assert codec.bytes_logical == 256
+        assert codec.bytes_wire == 64 + 8
+        assert codec.encode_seconds >= 0.0
+
+
+class TestConfigPlumbing:
+    def test_default_is_none(self):
+        tc = TrainingConfiguration(protocol="Asynchronous")
+        assert comm_codec_name(tc) == "none"
+        assert make_transport_codec(tc) is None
+
+    def test_comm_codec_selected(self):
+        codec = make_transport_codec(tc_for("int8"))
+        assert codec is not None and codec.kind == "int8"
+
+    def test_flat_codec_key_accepted(self):
+        tc = TrainingConfiguration(
+            protocol="Asynchronous", extra={"codec": "fp16"}
+        )
+        assert comm_codec_name(tc) == "fp16"
+
+    def test_topk_options(self):
+        codec = make_transport_codec(tc_for("topk", topK=7, minLeafSize=2))
+        assert codec.top_k == 7 and codec.min_leaf_size == 2
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(ValueError, match="unknown comm codec"):
+            comm_codec_name(tc_for("zstd"))
+
+    def test_explicit_none_builds_nothing(self):
+        assert make_transport_codec(tc_for("none")) is None

@@ -1,0 +1,383 @@
+"""Transport codec through the protocol stack: codec-none bit-identity,
+bytes-on-wire reduction, and convergence parity per protocol family —
+host plane (all 8 protocols route through the ship/deliver boundary) and
+the SPMD collective engine (QDQ at the allreduce boundary)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.job import REQUEST_STREAM, TRAINING_STREAM
+
+ALL_PROTOCOLS = [
+    "Asynchronous",
+    "Synchronous",
+    "SSP",
+    "EASGD",
+    "GM",
+    "FGM",
+]
+
+
+def stream_lines(n, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim)
+    y = (x @ w > 0).astype(np.float64)
+    return [
+        json.dumps(
+            {"numericalFeatures": list(np.round(x[i], 5)), "target": float(y[i])}
+        )
+        for i in range(n)
+    ]
+
+
+def run_job(protocol, lines, dim, comm=None, parallelism=4, batch=32,
+            extra=None):
+    cfg = JobConfig(parallelism=parallelism, batch_size=batch, test_set_size=32)
+    job = StreamJob(cfg)
+    tc = {"protocol": protocol, "syncEvery": 2}
+    if comm is not None:
+        tc["comm"] = comm
+    if extra:
+        tc.update(extra)
+    create = {
+        "id": 0,
+        "request": "Create",
+        "learner": {
+            "name": "PA",
+            "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": dim},
+        },
+        "trainingConfiguration": tc,
+    }
+    events = [(REQUEST_STREAM, json.dumps(create))] + [
+        (TRAINING_STREAM, l) for l in lines
+    ]
+    report = job.run(events)
+    assert report is not None
+    [stats] = report.statistics
+    return job, stats
+
+
+def worker_flats(job):
+    return [
+        s.nets[0].pipeline.get_flat_params()[0]
+        for s in job.spokes
+        if 0 in s.nets
+    ]
+
+
+def mean_stream_loss(job):
+    """Final cumulative loss per fitted record, summed over replicas —
+    a deterministic convergence figure independent of holdout sampling."""
+    cum = sum(
+        float(s.nets[0].pipeline.cumulative_loss)
+        for s in job.spokes if 0 in s.nets
+    )
+    fitted = sum(
+        int(s.nets[0].pipeline.fitted) for s in job.spokes if 0 in s.nets
+    )
+    return cum / max(fitted, 1)
+
+
+class TestCodecNoneBitIdentical:
+    """The acceptance pin: with codec ``none`` (explicit or default)
+    every route produces byte-for-byte the models of the pre-codec path."""
+
+    @pytest.mark.parametrize("protocol", ALL_PROTOCOLS)
+    def test_explicit_none_matches_default(self, protocol):
+        lines = stream_lines(800, dim=12)
+        job_a, stats_a = run_job(protocol, lines, 12)
+        job_b, stats_b = run_job(protocol, lines, 12, comm={"codec": "none"})
+        for fa, fb in zip(worker_flats(job_a), worker_flats(job_b)):
+            assert np.array_equal(fa, fb), protocol
+        assert stats_a.bytes_shipped == stats_b.bytes_shipped
+        assert stats_a.bytes_on_wire == stats_b.bytes_on_wire
+
+    def test_none_wire_equals_logical_for_model_shippers(self):
+        """Without a codec the wire carries the raw payloads, so the new
+        counter agrees with the logical accounting for the protocols whose
+        traffic is pure model pushes + updates."""
+        lines = stream_lines(800, dim=12)
+        _, stats = run_job("Asynchronous", lines, 12)
+        assert stats.bytes_on_wire == stats.bytes_shipped > 0
+
+
+class TestWireReduction:
+    def test_int8_cuts_wire_3_5x_on_params_dominated_stream(self):
+        dim = 256
+        lines = stream_lines(1200, dim=dim, seed=1)
+        _, none_stats = run_job("Asynchronous", lines, dim)
+        _, int8_stats = run_job(
+            "Asynchronous", lines, dim, comm={"codec": "int8"}
+        )
+        assert int8_stats.bytes_shipped == none_stats.bytes_shipped
+        reduction = none_stats.bytes_on_wire / max(int8_stats.bytes_on_wire, 1)
+        assert reduction >= 3.5, f"int8 wire reduction {reduction:.2f}x"
+
+    def test_fp16_cuts_wire_about_2x(self):
+        dim = 256
+        lines = stream_lines(1200, dim=dim, seed=1)
+        _, none_stats = run_job("Synchronous", lines, dim)
+        _, fp16_stats = run_job(
+            "Synchronous", lines, dim, comm={"codec": "fp16"}
+        )
+        reduction = none_stats.bytes_on_wire / max(fp16_stats.bytes_on_wire, 1)
+        assert 1.8 <= reduction <= 2.2, f"fp16 reduction {reduction:.2f}x"
+
+    def test_topk_cuts_wire_hardest(self):
+        dim = 256
+        lines = stream_lines(1200, dim=dim, seed=1)
+        _, none_stats = run_job("Asynchronous", lines, dim)
+        _, topk_stats = run_job(
+            "Asynchronous", lines, dim, comm={"codec": "topk"}
+        )
+        reduction = none_stats.bytes_on_wire / max(topk_stats.bytes_on_wire, 1)
+        assert reduction >= 6.0, f"topk reduction {reduction:.2f}x"
+
+
+class TestCodecValidation:
+    """Bad codec config is dropped at the gate (PipelineMap.scala:34,46
+    semantics) — it must never raise out of node construction and kill
+    the job."""
+
+    def test_unknown_codec_dropped_job_survives(self):
+        lines = stream_lines(400, dim=8)
+        cfg = JobConfig(parallelism=2, batch_size=16, test_set_size=16)
+        job = StreamJob(cfg)
+        bad = {
+            "id": 1, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "trainingConfiguration": {
+                "protocol": "Asynchronous", "comm": {"codec": "zstd"},
+            },
+        }
+        good = {
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "trainingConfiguration": {"protocol": "Asynchronous"},
+        }
+        events = (
+            [(REQUEST_STREAM, json.dumps(bad)),
+             (REQUEST_STREAM, json.dumps(good))]
+            + [(TRAINING_STREAM, l) for l in lines]
+        )
+        report = job.run(events)
+        assert report is not None
+        [stats] = report.statistics  # only the valid pipeline deployed
+        assert stats.pipeline == 0
+        assert stats.fitted > 100
+
+    def test_topk_on_spmd_engine_rejected_at_gate(self):
+        from omldm_tpu.api.requests import Request
+        from omldm_tpu.runtime.control import PipelineManager
+
+        req = Request.from_dict({
+            "id": 0, "request": "Create",
+            "learner": {
+                "name": "PA",
+                "dataStructure": {"nFeatures": 8},
+            },
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "engine": "spmd",
+                "comm": {"codec": "topk"},
+            },
+        })
+        err = PipelineManager().validate(req)
+        assert err is not None and "host-plane" in err
+
+    def test_topk_spmd_gate_is_case_blind(self):
+        """spmd_engine_requested lowercases the engine key; the gate must
+        match it or a casing variant deploys and raises past the gate."""
+        from omldm_tpu.api.requests import Request
+        from omldm_tpu.runtime.control import PipelineManager
+
+        req = Request.from_dict({
+            "id": 0, "request": "Create",
+            "learner": {
+                "name": "PA",
+                "dataStructure": {"nFeatures": 8},
+            },
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "engine": "SPMD",
+                "comm": {"codec": "topk"},
+            },
+        })
+        err = PipelineManager().validate(req)
+        assert err is not None and "host-plane" in err
+
+
+class TestQuickParity:
+    def test_int8_async_score_parity(self):
+        dim = 64
+        lines = stream_lines(1500, dim=dim, seed=2)
+        _, none_stats = run_job("Asynchronous", lines, dim)
+        _, int8_stats = run_job(
+            "Asynchronous", lines, dim, comm={"codec": "int8"}
+        )
+        assert none_stats.score > 0.8
+        assert abs(int8_stats.score - none_stats.score) <= 0.05
+
+    def test_int8_with_hub_sharding(self):
+        """Per-hub shard streams keep independent EF residuals; the
+        sharded PS still converges under compression."""
+        dim = 64
+        lines = stream_lines(1500, dim=dim, seed=2)
+        job, stats = run_job(
+            "Asynchronous", lines, dim,
+            comm={"codec": "int8"}, extra={"HubParallelism": 2},
+        )
+        assert len(job.hub_manager.hubs) == 2
+        assert stats.score > 0.8
+        for key, hub in job.hub_manager.hubs.items():
+            assert hub.node.stats.bytes_on_wire > 0, f"hub {key} idle"
+
+    def test_topk_sparse_linear_hashed_weights(self):
+        """topk's target workload: sparse_linear's hashed weight vector —
+        the model stays wide, each sync ships only the hot coordinates."""
+        dense, hash_space, dim = 8, 504, 512
+        rng = np.random.RandomState(3)
+        w = rng.randn(dense)
+        lines = []
+        for i in range(1000):
+            x = rng.randn(dense)
+            lines.append(json.dumps({
+                "numericalFeatures": list(np.round(x, 5)),
+                "categoricalFeatures": [f"c{rng.randint(40)}"],
+                "target": float(x @ w > 0),
+            }))
+        cfg = JobConfig(parallelism=2, batch_size=16, test_set_size=32)
+        jobs = {}
+        for comm in (None, {"codec": "topk", "topK": 64}):
+            job = StreamJob(cfg)
+            create = {
+                "id": 0,
+                "request": "Create",
+                "learner": {
+                    "name": "PA",
+                    "hyperParameters": {"C": 1.0},
+                    "dataStructure": {
+                        "sparse": True, "nFeatures": dim,
+                        "maxNnz": 16, "hashSpace": hash_space,
+                    },
+                },
+                "trainingConfiguration": {
+                    "protocol": "Asynchronous", "syncEvery": 2,
+                    **({"comm": comm} if comm else {}),
+                },
+            }
+            events = [(REQUEST_STREAM, json.dumps(create))] + [
+                (TRAINING_STREAM, l) for l in lines
+            ]
+            report = job.run(events)
+            [stats] = report.statistics
+            jobs["topk" if comm else "none"] = stats
+        assert jobs["none"].score > 0.7
+        assert jobs["topk"].score > 0.7
+        assert abs(jobs["topk"].score - jobs["none"].score) <= 0.1
+        reduction = jobs["none"].bytes_on_wire / max(
+            jobs["topk"].bytes_on_wire, 1
+        )
+        assert reduction >= 3.5, f"topk sparse reduction {reduction:.2f}x"
+
+
+@pytest.mark.slow
+class TestConvergenceParitySlow:
+    """The acceptance envelope: int8 + error feedback matches the
+    uncompressed final loss per protocol family on the seed workload."""
+
+    ENVELOPE_SCORE = 0.05
+    ENVELOPE_LOSS = 0.05
+
+    @pytest.mark.parametrize(
+        "protocol", ["Synchronous", "Asynchronous", "SSP", "EASGD", "GM", "FGM"]
+    )
+    def test_int8_final_loss_parity(self, protocol):
+        dim = 64
+        lines = stream_lines(6000, dim=dim, seed=4)
+        extra = {"threshold": 0.8} if protocol in ("GM", "FGM") else None
+        job_n, stats_n = run_job(protocol, lines, dim, extra=extra)
+        job_q, stats_q = run_job(
+            protocol, lines, dim, comm={"codec": "int8"}, extra=extra
+        )
+        assert stats_n.score > 0.8, f"{protocol} baseline failed to learn"
+        assert abs(stats_q.score - stats_n.score) <= self.ENVELOPE_SCORE, (
+            f"{protocol}: int8 score {stats_q.score} vs {stats_n.score}"
+        )
+        loss_n = mean_stream_loss(job_n)
+        loss_q = mean_stream_loss(job_q)
+        assert abs(loss_q - loss_n) <= self.ENVELOPE_LOSS + 0.1 * loss_n, (
+            f"{protocol}: int8 mean loss {loss_q:.4f} vs {loss_n:.4f}"
+        )
+
+
+class TestSPMDCodec:
+    """The collective engine's QDQ codec (the distributed job's
+    model-exchange route): none stays bit-identical, int8 cuts the wire
+    accounting >= 3.5x and holds the parameter-drift envelope."""
+
+    def _trainer(self, comm, steps=10, dim=256, protocol="Synchronous"):
+        import jax
+
+        from omldm_tpu.api.requests import LearnerSpec, TrainingConfiguration
+        from omldm_tpu.parallel.mesh import make_mesh
+        from omldm_tpu.parallel.spmd import SPMDTrainer
+
+        n_dev = len(jax.devices())
+        mesh = make_mesh(dp=n_dev, hub=1)
+        extra = {"syncEvery": 2}
+        if comm is not None:
+            extra["comm"] = comm
+        t = SPMDTrainer(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=dim,
+            protocol=protocol, mesh=mesh,
+            training_configuration=TrainingConfiguration(
+                protocol=protocol, extra=extra
+            ),
+            batch_size=16,
+        )
+        w = np.random.RandomState(45).randn(dim)
+        r = np.random.RandomState(6)
+        for _ in range(steps):
+            x = r.randn(n_dev, 16, dim).astype(np.float32)
+            y = (x @ w > 0).astype(np.float32)
+            t.step(x, y, np.ones((n_dev, 16), np.float32))
+        return t
+
+    def test_none_bit_identical(self):
+        t_def = self._trainer(None)
+        t_none = self._trainer({"codec": "none"})
+        assert np.array_equal(
+            t_def.global_flat_params(), t_none.global_flat_params()
+        )
+        assert "ef" not in t_def.state  # codec-none state tree unchanged
+
+    def test_int8_wire_reduction_and_drift(self):
+        t_none = self._trainer(None)
+        t_q = self._trainer({"codec": "int8"})
+        assert "ef" in t_q.state
+        assert t_q.bytes_shipped() == t_none.bytes_shipped()
+        assert t_none.bytes_on_wire() == t_none.bytes_shipped()
+        reduction = t_none.bytes_on_wire() / max(t_q.bytes_on_wire(), 1)
+        assert reduction >= 3.5, f"SPMD int8 reduction {reduction:.2f}x"
+        base = t_none.global_flat_params()
+        drift = np.linalg.norm(t_q.global_flat_params() - base)
+        assert drift <= 0.05 * np.linalg.norm(base) + 1e-3
+
+    def test_topk_rejected_on_collective_engine(self):
+        with pytest.raises(ValueError, match="host-plane"):
+            self._trainer({"codec": "topk"}, steps=0)
+
+    @pytest.mark.slow
+    def test_async_fold_int8_parity(self):
+        t_none = self._trainer(None, steps=32, protocol="Asynchronous")
+        t_q = self._trainer(
+            {"codec": "int8"}, steps=32, protocol="Asynchronous"
+        )
+        base = t_none.global_flat_params()
+        drift = np.linalg.norm(t_q.global_flat_params() - base)
+        assert drift <= 0.1 * np.linalg.norm(base) + 1e-3

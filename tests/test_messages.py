@@ -1,0 +1,63 @@
+"""payload_size exactness: array leaves report real buffer bytes (the
+reference's CountableSerial.getSize contract, FlinkMessage.scala:16-23),
+pinned for the payload shapes the protocols actually ship."""
+
+import numpy as np
+
+from omldm_tpu.runtime.messages import BroadcastMessage, Message, payload_size
+
+
+class TestPayloadSizePins:
+    def test_dense_model_payload(self):
+        flat = np.zeros((8, 28), np.float32)
+        assert payload_size(flat) == 8 * 28 * 4
+
+    def test_float64_counts_double(self):
+        assert payload_size(np.zeros((10,), np.float64)) == 80
+
+    def test_coo_payload(self):
+        idx = np.zeros((4, 16), np.int32)
+        val = np.zeros((4, 16), np.float32)
+        assert payload_size((idx, val)) == 4 * 16 * 4 * 2
+
+    def test_nested_dict_payload(self):
+        params = np.zeros((7,), np.float32)
+        payload = {
+            "params": params,           # 28
+            "curve": [(0.5, 10)],       # two python scalars -> 16
+            "fitted": 3,                # 8
+            "clock": 2,                 # 8
+        }
+        assert payload_size(payload) == 28 + 16 + 8 + 8
+
+    def test_numpy_scalars_exact_nbytes(self):
+        assert payload_size(np.float32(1.5)) == 4
+        assert payload_size(np.float64(1.5)) == 8
+        assert payload_size(np.int32(7)) == 4
+
+    def test_python_scalars_and_strings(self):
+        assert payload_size(1) == 8
+        assert payload_size(1.5) == 8
+        assert payload_size(True) == 8
+        assert payload_size("abc") == 3
+        assert payload_size(None) == 0
+
+    def test_message_header_accounting(self):
+        m = Message(0, "push", None, None, np.zeros((4,), np.float32))
+        assert m.get_size() == 16 + 16 + 16
+
+    def test_broadcast_message_per_destination_ids(self):
+        b = BroadcastMessage(0, "update", None, [1, 2, 3],
+                             np.zeros((4,), np.float32))
+        assert b.get_size() == 16 + 8 * 4 + 16
+
+
+class TestEncodedLeafIntegration:
+    def test_encoded_leaf_counts_wire_bytes(self):
+        from omldm_tpu.runtime.codec import TransportCodec
+
+        codec = TransportCodec("int8", min_leaf_size=4)
+        raw = {"params": np.zeros((64,), np.float32), "fitted": 1}
+        enc = codec.encode(raw, stream="s")
+        assert payload_size(raw) == 64 * 4 + 8
+        assert payload_size(enc) == 64 + 8 + 8  # q + meta + fitted
