@@ -85,7 +85,8 @@ def _codec_seconds(job) -> float:
 
 
 def run_one(protocol: str, x, y, parallelism: int, batch: int,
-            engine: str = "host", codec: str = "none"):
+            engine: str = "host", codec: str = "none", chaos: str = "",
+            sync_every: int = 4):
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -95,7 +96,8 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
     n = x.shape[0]
     job = StreamJob(
         JobConfig(
-            parallelism=parallelism, batch_size=batch, test_set_size=64
+            parallelism=parallelism, batch_size=batch, test_set_size=64,
+            chaos=chaos,
         )
     )
     create = {
@@ -106,7 +108,7 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
             "hyperParameters": {"C": 1.0},
             "dataStructure": {"nFeatures": int(x.shape[1])},
         },
-        "trainingConfiguration": {"protocol": protocol, "syncEvery": 4},
+        "trainingConfiguration": {"protocol": protocol, "syncEvery": sync_every},
     }
     if codec != "none":
         create["trainingConfiguration"]["comm"] = {"codec": codec}
@@ -132,6 +134,12 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "bytes_on_wire": stats.bytes_on_wire,
         "models_shipped": stats.models_shipped,
         "num_of_blocks": stats.num_of_blocks,
+        # resilience counters (runtime/messages receive windows + hub
+        # liveness): zero on fault-free runs, nonzero under chaos — BENCH
+        # rounds track chaos overhead through these
+        "duplicates_dropped": stats.duplicates_dropped,
+        "gaps_resynced": stats.gaps_resynced,
+        "quorum_releases": stats.quorum_releases,
     }
     if codec != "none":
         out["codec_seconds"] = round(_codec_seconds(job), 4)
@@ -145,6 +153,46 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
 # compares (the model-shipping protocols; GM/FGM traffic is mostly votes)
 CODEC_SWEEP = ("none", "fp16", "int8", "topk")
 CODEC_PROTOCOLS = ("Asynchronous", "Synchronous", "EASGD", "GM")
+
+# the acceptance chaos operating point (ISSUE 4): 5% drop, 5% dup,
+# reorder window 4 on both directions of the hub<->spoke bridge
+DEFAULT_CHAOS = "seed=7,drop=0.05,dup=0.05,reorder=0.1,window=4"
+
+
+def run_chaos_resilience(protocols, records, parallelism, batch,
+                         chaos=DEFAULT_CHAOS, dim=28):
+    """Each protocol on the same stream, fault-free vs under the seeded
+    chaos channel: final-score delta (the loss envelope) plus the
+    resilience counters the reliable channel accumulated while repairing
+    the damage."""
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    w = np.random.RandomState(42).randn(dim)
+    x = rng.randn(records, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    out = {"chaos_spec": chaos, "protocols": {}}
+    for protocol in protocols:
+        # syncEvery 1: the chaos section measures CHANNEL behavior, so it
+        # wants message volume, not the codec section's params economy
+        clean = run_one(protocol, x, y, parallelism, batch, sync_every=1)
+        chaotic = run_one(
+            protocol, x, y, parallelism, batch, chaos=chaos, sync_every=1
+        )
+        chaotic["score_delta_vs_clean"] = round(
+            chaotic["score"] - clean["score"], 4
+        )
+        chaotic["overhead_examples_per_sec"] = round(
+            clean["examples_per_sec"]
+            / max(chaotic["examples_per_sec"], 1e-9),
+            2,
+        )
+        out["protocols"][protocol] = {
+            "clean_score": clean["score"],
+            **chaotic,
+        }
+    return out
 
 
 def run_codec_comparison(codecs, records, parallelism, batch,
@@ -257,6 +305,19 @@ def main() -> None:
         "--smoke", action="store_true",
         help="CI mode: small stream, codec sections only, hard asserts",
     )
+    ap.add_argument(
+        "--chaos", default="",
+        help="chaos resilience section: run the parameter protocols "
+             "fault-free vs under this seeded chaos spec ('default' for "
+             f"'{DEFAULT_CHAOS}') and report score deltas + resilience "
+             "counters",
+    )
+    ap.add_argument(
+        "--chaos-smoke", action="store_true",
+        help="CI gate: short Synchronous + Asynchronous runs under seeded "
+             "drop+dup+reorder chaos; NONZERO EXIT if a run crashes or "
+             "leaves the fault-free loss envelope",
+    )
     args = ap.parse_args()
 
     import os
@@ -282,6 +343,46 @@ def main() -> None:
         else ("none", args.codec) if args.codec != "none"
         else ()
     )
+
+    if args.chaos_smoke:
+        # CI gate: a short Sync + Async run under seeded drop+dup+reorder
+        # chaos — the job must finish (zero crashes) with the final score
+        # inside the fault-free loss envelope, and the reliable channel
+        # must actually have worked (nonzero resilience counters). The dup
+        # rate is cranked above the acceptance operating point so the
+        # ~200-message smoke stream statistically guarantees duplicate
+        # deliveries for the counter gate
+        res = run_chaos_resilience(
+            ("Synchronous", "Asynchronous"),
+            min(args.records, 6_000),
+            min(args.parallelism, 4),
+            min(args.batch, 64),
+            chaos="seed=7,drop=0.05,dup=0.25,reorder=0.1,window=4",
+        )
+        failures = []
+        for protocol, r in res["protocols"].items():
+            if abs(r["score_delta_vs_clean"]) > 0.05:
+                failures.append(
+                    f"{protocol} chaos score delta "
+                    f"{r['score_delta_vs_clean']} outside the 0.05 envelope"
+                )
+            if r["duplicates_dropped"] == 0:
+                failures.append(
+                    f"{protocol} saw no duplicates under dup chaos — the "
+                    "reliable channel is not engaged"
+                )
+        print(
+            json.dumps(
+                {
+                    "config": "protocol_comparison_chaos_smoke",
+                    **res,
+                    "failures": failures,
+                }
+            )
+        )
+        if failures:
+            sys.exit(1)
+        return
 
     if args.smoke:
         # CI gate: the codec path end to end on a small stream, with the
@@ -379,6 +480,14 @@ def main() -> None:
             args.batch,
         )
         codec_out["distributed_route"] = run_distributed_route(codecs)
+    # chaos resilience section (--chaos): protocols under the seeded lossy
+    # channel, score envelope + resilience counters
+    if args.chaos:
+        spec = DEFAULT_CHAOS if args.chaos == "default" else args.chaos
+        codec_out["chaos_resilience"] = run_chaos_resilience(
+            SPMD_PROTOCOLS, max(args.records // 4, 8_000),
+            args.parallelism, args.batch, chaos=spec,
+        )
     print(
         json.dumps(
             {

@@ -1158,7 +1158,13 @@ def main():
              # records bytes_on_wire per protocol (comm volume, not just
              # throughput) in the results JSON; the sweep roughly doubles
              # the section's work, so the timeout doubles with it
-             "--codec", "sweep"],
+             "--codec", "sweep",
+             # and the chaos resilience section: every BENCH round records
+             # the lossy-channel counters (duplicatesDropped, gapsResynced,
+             # quorumReleases) and the chaos throughput/score overhead per
+             # protocol, so regressions in the hardening layer show up in
+             # the results JSON, not just in CI
+             "--chaos", "default"],
             capture_output=True, text=True, timeout=3600,
             env={**os.environ, "PYTHONPATH": child_path},
         )

@@ -41,6 +41,15 @@ class Statistics:
     # only the reference's getSize call sites
     bytes_on_wire: int = 0
     num_of_blocks: int = 0
+    # reliable-channel resilience counters (zero on the default
+    # exactly-once in-process route): duplicate deliveries dropped by a
+    # receive window, sequence gaps that triggered a NACK/resync cycle,
+    # and barrier releases taken on a quorum while a silent worker was
+    # retired from round accounting (runtime/messages.ReceiveWindow,
+    # protocols/base.HubNode liveness)
+    duplicates_dropped: int = 0
+    gaps_resynced: int = 0
+    quorum_releases: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -53,12 +62,18 @@ class Statistics:
         bytes_shipped: int = 0,
         num_of_blocks: int = 0,
         bytes_on_wire: int = 0,
+        duplicates_dropped: int = 0,
+        gaps_resynced: int = 0,
+        quorum_releases: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127)."""
         self.models_shipped += models_shipped
         self.bytes_shipped += bytes_shipped
         self.num_of_blocks += num_of_blocks
         self.bytes_on_wire += bytes_on_wire
+        self.duplicates_dropped += duplicates_dropped
+        self.gaps_resynced += gaps_resynced
+        self.quorum_releases += quorum_releases
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -99,6 +114,9 @@ class Statistics:
             bytes_shipped=self.bytes_shipped + other.bytes_shipped,
             bytes_on_wire=self.bytes_on_wire + other.bytes_on_wire,
             num_of_blocks=self.num_of_blocks + other.num_of_blocks,
+            duplicates_dropped=self.duplicates_dropped + other.duplicates_dropped,
+            gaps_resynced=self.gaps_resynced + other.gaps_resynced,
+            quorum_releases=self.quorum_releases + other.quorum_releases,
             fitted=self.fitted + other.fitted,
             mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
             score=self.score + other.score,
@@ -119,6 +137,9 @@ class Statistics:
             "modelsShipped": self.models_shipped,
             "bytesShipped": self.bytes_shipped,
             "bytesOnWire": self.bytes_on_wire,
+            "duplicatesDropped": self.duplicates_dropped,
+            "gapsResynced": self.gaps_resynced,
+            "quorumReleases": self.quorum_releases,
             "numOfBlocks": self.num_of_blocks,
             "fitted": self.fitted,
             "learningCurve": self.learning_curve,

@@ -65,6 +65,16 @@ class JobConfig:
     # Poll/progress marker cadence in #training records (FlinkSpoke.scala:83-89).
     poll_every: int = 100
 
+    # --- lossy-channel hardening (no reference counterpart: the reference
+    # rides Kafka at-least-once and hopes) ---
+    # Deterministic chaos spec for the in-process hub<->spoke bridge, e.g.
+    # "seed=7,drop=0.05,dup=0.05,reorder=0.1,window=4" (per-direction
+    # overrides: "up.drop=...", "down.dup=..."). Empty (default) = fault
+    # free; the OMLDM_CHAOS env var arms it too (reaches worker
+    # subprocesses). When armed, the reliable channel (sequence numbers,
+    # receive windows, NACK/resync) arms itself per pipeline.
+    chaos: str = ""
+
     # --- TPU-native knobs (no reference counterpart) ---
     # Micro-batch size per training step; records are padded + masked to this
     # fixed shape so the jitted step never recompiles.

@@ -19,7 +19,8 @@ per-pipeline ``Statistics``.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import time
+from typing import Any, Callable, Optional, Set
 
 import numpy as np
 
@@ -27,7 +28,12 @@ from omldm_tpu.api.requests import TrainingConfiguration
 from omldm_tpu.api.stats import Statistics
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.runtime.codec import make_transport_codec
-from omldm_tpu.runtime.messages import payload_size
+from omldm_tpu.runtime.messages import (
+    OP_NACK,
+    OP_RESYNC,
+    comm_dict,
+    payload_size,
+)
 
 # send(op: str, payload, hub_id: int) -> None           (worker -> hub)
 SendFn = Callable[[str, Any, int], None]
@@ -64,6 +70,10 @@ class WorkerNode:
         self.codec = make_transport_codec(config)
         if self.codec is not None:
             self.send = self._send_encoded
+        # reliable-channel plumbing: set True by the runtime (SpokeNet)
+        # when the pipeline's channel runs the lossy-channel hardening
+        # layer; gates the stall watchdog in SyncingWorker
+        self.channel_armed = False
 
     def _send_encoded(self, op: str, payload: Any, hub_id: int = 0) -> None:
         payload = self.codec.encode(
@@ -74,10 +84,41 @@ class WorkerNode:
     def deliver(self, op: str, payload: Any, hub_id: int = 0) -> None:
         """Receive boundary: decode transport-encoded payloads exactly
         once, then hand the raw payload to :meth:`receive`. The runtime
-        (Spoke.receive_from_hub) routes hub messages through here."""
+        (Spoke.receive_from_hub) routes hub messages through here.
+        Reliable-channel control messages (NACK / authoritative resync,
+        which ship UNencoded) divert to their handlers before protocol
+        logic ever sees them."""
+        if op == OP_NACK:
+            self.on_channel_nack(hub_id)
+            return
+        if op == OP_RESYNC:
+            self.on_resync(payload, hub_id)
+            return
         if self.codec is not None:
             payload = self.codec.decode(payload)
         self.receive(op, payload, hub_id)
+
+    # --- reliable-channel hooks (no-ops on the default exactly-once route) ---
+
+    def on_channel_nack(self, hub_id: int = 0) -> None:
+        """Hub shard ``hub_id`` detected a gap (or a stalled round) on OUR
+        outgoing stream: restart the stream's codec state so the next topk
+        encode re-anchors, and re-push local state so a lost contribution
+        cannot stall a barrier forever. Base workers have no pending
+        exchange to re-fire; SyncingWorker overrides ``resend_state``."""
+        if self.codec is not None:
+            self.codec.reset_tx_stream(f"w{self.worker_id}>h{hub_id}")
+        self.resend_state(hub_id)
+
+    def resend_state(self, hub_id: int = 0) -> None:
+        """Re-ship whatever the protocol's hub needs from this worker."""
+
+    def on_resync(self, payload: Any, hub_id: int = 0) -> None:
+        """Authoritative full-state re-ship from hub ``hub_id`` (sent after
+        a NACK, a quorum re-admission, or a detected gap). ``payload`` is a
+        raw (never codec-encoded) dict with at least ``params``. Base
+        workers ignore it (their model is local-only); parameter-exchanging
+        workers (SyncingWorker) adopt the shard and clear wait state."""
 
     def on_start(self) -> None:
         """Called once after creation (e.g. async workers pull the model)."""
@@ -157,6 +198,24 @@ class HubNode:
         self.codec = make_transport_codec(config)
         self.reply = self._reply_ship
         self.broadcast = self._broadcast_ship
+        # --- hub-side worker liveness (comm.quorum / comm.workerTimeoutMs) ---
+        # With a quorum configured, a worker silent beyond the timeout is
+        # RETIRED from round accounting (the hub-side half of the
+        # shrink-rescale path: its barrier entries prune and barriers
+        # re-evaluate, set_parallelism-style) as long as >= quorum workers
+        # stay active; a retired worker that speaks again is re-admitted
+        # as a fresh join and caught up with an authoritative resync.
+        # Default (quorum unset): n-of-n, the exact pre-liveness behavior.
+        comm = comm_dict(config)
+        q = comm.get("quorum")
+        self.quorum: Optional[int] = int(q) if q is not None else None
+        self.worker_timeout_s = (
+            float(comm.get("workerTimeoutMs", 30_000)) / 1000.0
+        )
+        self._clock = time.time  # injectable (tests use a fake clock)
+        self._last_seen: dict = {}
+        self._liveness_epoch: Optional[float] = None
+        self._retired_live: Set[int] = set()
 
     def _reply_ship(self, worker_id: int, op: str, payload: Any) -> None:
         if self.codec is not None:
@@ -175,6 +234,108 @@ class HubNode:
             bytes_on_wire=payload_size(payload) * self.n_workers
         )
         self._broadcast_raw(op, payload)
+
+    # --- worker liveness + quorum round release ------------------------------
+
+    @property
+    def liveness_armed(self) -> bool:
+        return self.quorum is not None
+
+    def active_workers(self):
+        """Worker ids currently counted by barriers (liveness-retired ids
+        excluded)."""
+        return [w for w in range(self.n_workers) if w not in self._retired_live]
+
+    def round_target(self) -> int:
+        """Contributions a barrier needs to release: the active worker
+        count (== ``n_workers`` until liveness retires someone)."""
+        return max(self.n_workers - len(self._retired_live), 1)
+
+    def note_worker(self, worker_id: int) -> None:
+        """Record a sign of life; re-admit a liveness-retired worker as a
+        fresh join (it is counted by barriers again and caught up with an
+        authoritative resync, like a grow-rescale seed)."""
+        now = self._clock()
+        if self._liveness_epoch is None:
+            self._liveness_epoch = now
+        self._last_seen[worker_id] = now
+        if worker_id in self._retired_live:
+            self._retired_live.discard(worker_id)
+            self.resync_worker(worker_id)
+
+    def check_liveness(self) -> None:
+        """Retire workers silent beyond ``comm.workerTimeoutMs`` — never
+        below the quorum floor — and re-evaluate any barrier the smaller
+        active set now satisfies. Runs on every hub receive: message
+        arrival is the only clock tick a streaming hub gets."""
+        if not self.liveness_armed or self._liveness_epoch is None:
+            return
+        now = self._clock()
+        retired_any = False
+        for w in range(self.n_workers):
+            if w in self._retired_live:
+                continue
+            if self.round_target() <= max(self.quorum, 1):
+                break  # at the quorum floor: nobody else may retire
+            seen = self._last_seen.get(w, self._liveness_epoch)
+            if now - seen > self.worker_timeout_s:
+                self._retired_live.add(w)
+                retired_any = True
+                self.worker_retired(w)
+        if retired_any:
+            self._barrier_recheck()
+
+    def worker_retired(self, worker_id: int) -> None:
+        """Liveness retired ``worker_id`` mid-round: protocols with
+        worker-keyed barrier state drop its entries here (the per-worker
+        half of the shrink-rescale pruning; the barrier re-evaluation
+        follows in :meth:`_barrier_recheck`)."""
+
+    def _barrier_recheck(self) -> None:
+        """Re-evaluate every barrier against the reduced active set. Must
+        be overridden by protocols with rounds/clocks/polls — a barrier
+        blocked on a retired worker would otherwise never release, since
+        the check normally only runs inside receive()."""
+
+    def note_round_release(self) -> None:
+        """Protocols call this when a barrier releases; releases taken
+        while workers are liveness-retired are quorum releases."""
+        if self._retired_live:
+            self.stats.update_stats(quorum_releases=1)
+
+    def resync_payload(self) -> Optional[dict]:
+        """The hub's authoritative state for a catch-up re-ship (``params``
+        key at minimum), or None when there is nothing authoritative yet."""
+        params = getattr(self, "global_params", None)
+        if params is None:
+            return None
+        return {"params": params}
+
+    def resync_worker(self, worker_id: int) -> None:
+        """Re-ship authoritative state to one worker (answering a NACK, or
+        catching up a re-admitted worker). Ships RAW — bypassing the codec
+        — and restarts the codec's tx stream to that worker so the next
+        topk delta re-anchors instead of building on a base the receiver
+        no longer has."""
+        if self.codec is not None:
+            self.codec.reset_tx_stream(f"h{self.hub_id}>w{worker_id}")
+        payload = self.resync_payload()
+        if payload is None:
+            return
+        self.stats.update_stats(bytes_on_wire=payload_size(payload))
+        self._reply_raw(worker_id, OP_RESYNC, payload)
+
+    def nack_worker(self, worker_id: int) -> None:
+        """Ask one worker to re-ship its state (our receive window
+        declared a gap on its stream)."""
+        self.stats.update_stats(bytes_on_wire=payload_size({"gap": True}))
+        self._reply_raw(worker_id, OP_NACK, {"gap": True})
+
+    def on_nack(self, worker_id: int, payload: Any = None) -> None:
+        """A worker NACKed us (gap on its receive window, or a stall
+        watchdog firing behind a lost round release): re-ship the
+        authoritative model."""
+        self.resync_worker(worker_id)
 
     # --- statistics helpers (byte accounting at the send sites, mirroring
     # FlinkHub.scala:118-127 / FlinkNetwork getSize calls) ---
@@ -224,6 +385,14 @@ class HubNode:
         if isinstance(seen, dict):
             for w in [w for w in seen if isinstance(w, int) and w >= n_workers]:
                 seen[w % n_workers] = seen.get(w % n_workers, 0) + seen.pop(w)
+        # liveness bookkeeping follows the shrink: retired slots vanish
+        self._prune_retired(self._last_seen, n_workers)
+        self._retired_live = {w for w in self._retired_live if w < n_workers}
+        # a worker slot reused after shrink-absorb starts fresh streams:
+        # the codec must not decode (or delta-encode) against a dead
+        # worker's stale bases (receive-side bases included)
+        if self.codec is not None:
+            self.codec.reset_retired_worker_streams(n_workers)
 
     @staticmethod
     def _prune_retired(d: dict, n_workers: int) -> None:

@@ -16,6 +16,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from omldm_tpu.protocols.base import WorkerNode
+from omldm_tpu.runtime.messages import DEFAULT_STALL_AFTER, OP_NACK, comm_dict
 
 # cap on batches buffered while blocked on the PS (the reference's record
 # buffer cap is 100_000 records, SpokeLogic.scala:32)
@@ -38,6 +39,16 @@ class SyncingWorker(WorkerNode):
         self._batches = 0
         self.waiting = False
         self._blocked: List[Tuple[Any, Any, Any]] = []
+        # stall watchdog (reliable channel only): a worker that buffers
+        # ``stallAfter`` batches while waiting suspects a lost message —
+        # either its push never reached the PS (a barrier nobody can
+        # complete) or the round release never reached it. It NACKs every
+        # hub (-> authoritative resync) and re-pushes its contribution
+        # (barrier entries are worker-keyed, so the re-push is idempotent).
+        self._stall_after = int(
+            comm_dict(self.config).get("stallAfter", DEFAULT_STALL_AFTER)
+        )
+        self._stalled_batches = 0
 
     # --- flat param helpers ---
 
@@ -92,7 +103,13 @@ class SyncingWorker(WorkerNode):
         if self.waiting:
             if len(self._blocked) < MAX_BLOCKED_BATCHES:
                 self._blocked.append((x, y, mask))
+            if self.channel_armed and self._stall_after > 0:
+                self._stalled_batches += 1
+                if self._stalled_batches >= self._stall_after:
+                    self._stalled_batches = 0
+                    self.on_stall()
             return None
+        self._stalled_batches = 0
         loss = self.pipeline.fit(x, y, mask)
         self._batches += 1
         if self._batches % self.sync_every == 0:
@@ -124,6 +141,38 @@ class SyncingWorker(WorkerNode):
     def on_sync_point(self) -> None:
         """Called every ``syncEvery`` batches; protocol-specific."""
         raise NotImplementedError
+
+    # --- reliable-channel recovery ---
+
+    def on_stall(self) -> None:
+        """Blocked too long: assume a lost message on one of our streams.
+        NACK every hub shard (each replies with an authoritative resync if
+        it has state) and re-push our own contribution in case it was the
+        push that vanished."""
+        for h in range(self.n_hubs):
+            self.send(OP_NACK, {"stall": True}, h)
+        if self.waiting:
+            self.resend_state()
+
+    def resend_state(self, hub_id: int = 0) -> None:
+        """Re-ship this worker's current contribution (idempotent on the
+        PS: round/collection entries are keyed by worker id)."""
+        self.final_push()
+
+    def on_resync(self, payload: Any, hub_id: int = 0) -> None:
+        """Adopt the hub's authoritative shard and clear this hub's wait
+        state — the resync stands in for whatever release message was
+        lost. Protocol subclasses refine ``channel_resynced`` (re-anchor
+        drift baselines, clear per-hub pending sets)."""
+        params = (payload or {}).get("params")
+        if params is not None:
+            self.apply_shard(np.asarray(params), hub_id)
+        self.channel_resynced(payload or {}, hub_id)
+        if not self.waiting:
+            self.drain_blocked()
+
+    def channel_resynced(self, payload: dict, hub_id: int) -> None:
+        self.waiting = False
 
     def on_flush(self) -> None:
         """Quiesce: push whatever the protocol needs for final stats."""

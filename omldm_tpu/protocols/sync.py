@@ -17,7 +17,7 @@ staleness) — MLNodeGenerator.scala:20-76.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional
 
 import numpy as np
 
@@ -48,6 +48,11 @@ class SynchronousWorker(SyncingWorker):
                 self.waiting = False
                 self.drain_blocked()
 
+    def channel_resynced(self, payload: dict, hub_id: int) -> None:
+        # the resync stands in for this hub shard's lost round release
+        self._pending_hubs.discard(hub_id)
+        self.waiting = bool(self._pending_hubs)
+
     def final_push(self) -> None:
         self.send_vector(OP_PUSH, "params", self.get_flat())
 
@@ -76,16 +81,28 @@ class SynchronousParameterServer(HubNode):
         self._maybe_finish_round()
 
     def _maybe_finish_round(self) -> None:
-        if len(self._round) >= self.n_workers:
+        # round_target shrinks when liveness retires a silent worker, so a
+        # quorum of live contributions releases the round instead of the
+        # whole fleet blocking on a dead straggler forever
+        if len(self._round) >= self.round_target():
             stacked = np.stack(list(self._round.values()))
             self.global_params = stacked.mean(axis=0)
             self._round.clear()
+            self.note_round_release()
             self.count_shipped(
                 self.global_params,
                 n_dest=self.n_workers,
                 models=self.n_workers if self.hub_id == 0 else 0,
             )
             self.broadcast(OP_UPDATE, self.global_params)
+
+    def worker_retired(self, worker_id: int) -> None:
+        # its in-flight contribution (if any) still averages into the
+        # round it already joined; it just stops being waited for
+        pass
+
+    def _barrier_recheck(self) -> None:
+        self._maybe_finish_round()
 
     def set_parallelism(self, n_workers: int) -> None:
         """Shrink may leave the pruned round already complete — with every
@@ -128,10 +145,69 @@ class SSPWorker(SyncingWorker):
             if not self.waiting:
                 self.drain_blocked()
 
+    def channel_resynced(self, payload: dict, hub_id: int) -> None:
+        # an authoritative resync releases this hub's staleness hold (the
+        # PS only resyncs workers it considers releasable or re-admitted)
+        self._wait_hubs.discard(hub_id)
+        self.waiting = bool(self._wait_hubs)
+
     def final_push(self) -> None:
         self.send_vector(
             OP_PUSH, "params", self.get_flat(), extra={"clock": self.clock}
         )
+
+
+class SSPClock:
+    """Per-worker SSP round clocks + wait-set.
+
+    Owns the two worker-keyed tables of the staleness barrier (last pushed
+    clock, blocked-on-staleness flag) so retirement — live rescale shrink
+    or liveness retirement of a silent straggler — edits them through ONE
+    audited path. ``slowest`` ranges over the ACTIVE workers only: a
+    retired worker must neither anchor the staleness window at its dead
+    clock nor count as a clock-0 "never pushed" member, or every survivor
+    ahead of it blocks forever."""
+
+    def __init__(self, staleness: int):
+        self.staleness = int(staleness)
+        self.clocks: Dict[int, int] = {}
+        self.waiting: Dict[int, bool] = {}
+
+    def note_push(self, worker_id: int, clock: int) -> None:
+        self.clocks[worker_id] = clock
+
+    def slowest(self, active: Iterable[int]) -> int:
+        clocks = [self.clocks.get(w, 0) for w in active]
+        return min(clocks) if clocks else 0
+
+    def should_wait(self, worker_id: int, active: Iterable[int]) -> bool:
+        wait = (
+            self.clocks.get(worker_id, 0) - self.slowest(active)
+            > self.staleness
+        )
+        self.waiting[worker_id] = wait
+        return wait
+
+    def releasable(self, active: Iterable[int]) -> list:
+        """Waiting workers back inside the staleness bound, marked
+        released. Evaluated against the CURRENT active set, so it must be
+        re-run whenever that set shrinks — including when the last
+        straggler a survivor was waiting on retires mid-round."""
+        slowest = self.slowest(active)
+        out = []
+        for w, waiting in list(self.waiting.items()):
+            if waiting and self.clocks.get(w, 0) - slowest <= self.staleness:
+                self.waiting[w] = False
+                out.append(w)
+        return out
+
+    def worker_retired(self, worker_id: int) -> None:
+        """Drop a retired worker from the window entirely: its clock no
+        longer anchors ``slowest`` and it cannot sit in the wait-set. The
+        caller MUST re-evaluate ``releasable`` afterwards — the retirement
+        may have been the only thing a survivor was waiting on."""
+        self.clocks.pop(worker_id, None)
+        self.waiting.pop(worker_id, None)
 
 
 class SSPParameterServer(HubNode):
@@ -140,15 +216,18 @@ class SSPParameterServer(HubNode):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.staleness = int(self.config.extra.get("staleness", 3))
-        self._clocks: Dict[int, int] = {}
+        self._clock_table = SSPClock(self.staleness)
         self._fitted_seen: Dict[int, int] = {}
-        self._waiting: Dict[int, bool] = {}
         self.global_params: Optional[np.ndarray] = None
 
-    def _slowest(self) -> int:
-        if len(self._clocks) < self.n_workers:
-            return 0  # workers that never pushed are at clock 0
-        return min(self._clocks.values())
+    # worker-keyed views, shared with tests and the rescale pruning path
+    @property
+    def _clocks(self) -> Dict[int, int]:
+        return self._clock_table.clocks
+
+    @property
+    def _waiting(self) -> Dict[int, bool]:
+        return self._clock_table.waiting
 
     def receive(self, worker_id: int, op: str, payload: Any) -> None:
         if op != OP_PUSH:
@@ -159,7 +238,7 @@ class SSPParameterServer(HubNode):
         self._fitted_seen[worker_id] = payload["fitted"]
         self.stats.update_fitted(max(d, 0))
 
-        self._clocks[worker_id] = payload["clock"]
+        self._clock_table.note_push(worker_id, payload["clock"])
         if self.global_params is None:
             self.global_params = payload["params"].copy()
         else:
@@ -168,9 +247,7 @@ class SSPParameterServer(HubNode):
                 self.global_params * (self.n_workers - 1) + payload["params"]
             ) / float(self.n_workers)
 
-        ahead = payload["clock"] - self._slowest()
-        wait = ahead > self.staleness
-        self._waiting[worker_id] = wait
+        wait = self._clock_table.should_wait(worker_id, self.active_workers())
         self.count_shipped(
             self.global_params, models=1 if self.hub_id == 0 else 0
         )
@@ -179,21 +256,28 @@ class SSPParameterServer(HubNode):
             self._release_unblocked()
 
     def _release_unblocked(self) -> None:
-        slowest = self._slowest()
-        for w, waiting in list(self._waiting.items()):
-            if waiting and self._clocks.get(w, 0) - slowest <= self.staleness:
-                self._waiting[w] = False
-                self.count_shipped(
-                    self.global_params, models=1 if self.hub_id == 0 else 0
-                )
-                self.reply(w, OP_UPDATE, {"params": self.global_params, "wait": False})
+        for w in self._clock_table.releasable(self.active_workers()):
+            self.note_round_release()
+            self.count_shipped(
+                self.global_params, models=1 if self.hub_id == 0 else 0
+            )
+            self.reply(w, OP_UPDATE, {"params": self.global_params, "wait": False})
+
+    def worker_retired(self, worker_id: int) -> None:
+        self._clock_table.worker_retired(worker_id)
+
+    def _barrier_recheck(self) -> None:
+        # the retired straggler may have been the LAST thing holding the
+        # staleness window down; survivors waiting only on it release here
+        if self.global_params is not None:
+            self._release_unblocked()
 
     def set_parallelism(self, n_workers: int) -> None:
         """Retired clocks leave the staleness window; re-evaluate releases
         (a survivor may only have been waiting on a retired straggler)."""
         super().set_parallelism(n_workers)
-        self._prune_retired(self._clocks, n_workers)
-        self._prune_retired(self._waiting, n_workers)
+        for w in [w for w in list(self._clocks) if w >= n_workers]:
+            self._clock_table.worker_retired(w)
         if self.global_params is not None:
             self._release_unblocked()
 

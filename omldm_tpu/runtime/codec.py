@@ -46,6 +46,7 @@ Codecs (``trainingConfiguration.comm.codec``):
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -277,6 +278,39 @@ class TransportCodec:
         self._tx_base.clear()
         self._tx_seq.clear()
         self._rx_base.clear()
+
+    # stream keys embed the worker endpoint as ``w<id>`` (``w3>h0``,
+    # ``h0>w3``); ``h0>*`` broadcast streams name no worker
+    _WORKER_IN_STREAM = re.compile(r"(?:^|>)w(\d+)(?:>|$)")
+
+    def reset_tx_stream(self, stream: str) -> None:
+        """Restart one OUTGOING stream from scratch: residuals drop and the
+        next topk encode re-anchors at seq 0 / zero base. The reliable
+        channel calls this on a NACK so a receiver that lost deltas
+        realigns within one message instead of one anchor cycle."""
+        for d in (self._residual, self._tx_base, self._tx_seq):
+            for key in [k for k in d if k[0] == stream]:
+                del d[key]
+
+    def reset_rx_stream(self, stream: str) -> None:
+        """Drop the RECEIVE-side delta bases of one stream (the reliable
+        channel detected a gap: the base no longer matches the sender's)."""
+        for key in [k for k in self._rx_base if k[0] == stream]:
+            del self._rx_base[key]
+
+    def reset_retired_worker_streams(self, n_workers: int) -> None:
+        """Drop every per-stream state — INCLUDING receive-side delta
+        bases — belonging to worker node-ids retired by a shrink
+        (id >= ``n_workers``). A worker slot reused by a later grow starts
+        a fresh stream at seq 0; without this, the hub side would still
+        hold the dead worker's bases/residuals keyed to the same stream
+        names, and a mid-cycle tx base would make the reused slot decode
+        garbage until the next anchor."""
+        for d in (self._residual, self._tx_base, self._tx_seq, self._rx_base):
+            for key in list(d):
+                m = self._WORKER_IN_STREAM.search(key[0])
+                if m is not None and int(m.group(1)) >= n_workers:
+                    del d[key]
 
 
 def _decode_leaf(leaf: EncodedLeaf, codec: Optional[TransportCodec], path: str):

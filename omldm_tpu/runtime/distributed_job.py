@@ -1759,6 +1759,16 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     consumer = KafkaConsumer(
         bootstrap_servers=brokers, consumer_timeout_ms=poll_ms
     )
+    # broker chaos (--kafkaChaos flag / OMLDM_CHAOS_KAFKA env): seeded
+    # drop/dup/reorder on the DATA record stream — dropped records'
+    # offsets are never committed, so checkpoint/restore replays them:
+    # at-least-once, exactly the reference's Kafka source contract. The
+    # control (requests) consumer stays clean: duplicated Creates are
+    # dropped by the admit gate anyway, but lost ones would change the
+    # topology
+    from omldm_tpu.runtime.supervisor import maybe_chaos_consumer
+
+    consumer = maybe_chaos_consumer(consumer, flags, name=f"kafka-p{job.pid}")
 
     def _partitions(client, topic, retries=5):
         # metadata fetch through the shared backoff helper (no hand-rolled

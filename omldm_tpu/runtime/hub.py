@@ -21,7 +21,15 @@ from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.centralized import CentralizedMLServer
 from omldm_tpu.protocols.registry import make_hub_node, resolve_protocol
 from omldm_tpu.runtime.databuffers import DataSet
-from omldm_tpu.runtime.messages import payload_size
+from omldm_tpu.runtime.messages import (
+    OP_NACK,
+    ReceiveWindow,
+    StreamSequencer,
+    channel_chaos_spec,
+    channel_window_size,
+    payload_size,
+    reliability_armed,
+)
 
 
 class Hub:
@@ -57,6 +65,15 @@ class Hub:
         # stats carry the resolved protocol, not the requested one (the
         # forcing rules of FlinkSpoke.scala:203-215 may have overridden it)
         self.node.stats.protocol = protocol
+        # reliable channel: one receive window per worker stream, armed
+        # per pipeline (None => the exact pre-reliable receive path)
+        self._windows: Optional[Dict[int, ReceiveWindow]] = (
+            {}
+            if reliability_armed(tc, channel_chaos_spec(config))
+            else None
+        )
+        self._window_size = channel_window_size(tc)
+        self._quiesced = False
         # SingleLearner: the central model lives here (FlinkHub.scala:128-153)
         if isinstance(self.node, CentralizedMLServer):
             self.node.attach_pipeline(
@@ -69,15 +86,79 @@ class Hub:
                 )
             )
 
-    def receive(self, worker_id: int, op: str, payload: Any) -> None:
+    def receive(
+        self, worker_id: int, op: str, payload: Any, seq: Optional[int] = None
+    ) -> None:
+        """Worker->hub receive boundary.
+
+        With the reliable channel armed, every message passes the
+        per-worker :class:`ReceiveWindow` first: duplicates drop (counted),
+        out-of-order messages hold until their gap fills, and a gap that
+        outlives the window fast-forwards + NACKs the worker for an
+        authoritative re-push (its codec delta stream re-anchors too).
+        Liveness is clocked here as well — a message from anyone is the
+        only timer a streaming hub gets."""
+        if self.node.liveness_armed:
+            self.node.note_worker(worker_id)
+            self.node.check_liveness()
+        if seq is None or self._windows is None:
+            self._dispatch(worker_id, op, payload)
+            return
+        window = self._windows.get(worker_id)
+        if window is None:
+            # a window born after quiesce (every earlier message from this
+            # worker was lost) starts in pass-through, or its first
+            # terminate-time push would be held forever
+            window = self._windows[worker_id] = ReceiveWindow(
+                self._window_size, passthrough=self._quiesced
+            )
+        res = window.offer(seq, op, payload)
+        if res.duplicates:
+            self.node.stats.update_stats(duplicates_dropped=res.duplicates)
+        if res.gap:
+            self.node.stats.update_stats(gaps_resynced=1)
+            if self.node.codec is not None:
+                # deltas were lost: the rx base no longer matches the
+                # sender's; drop it and make the sender re-anchor
+                self.node.codec.reset_rx_stream(f"w{worker_id}>h{self.hub_id}")
+            self.node.nack_worker(worker_id)
+        for d_op, d_payload in res.deliver:
+            self._dispatch(worker_id, d_op, d_payload)
+
+    def _dispatch(self, worker_id: int, op: str, payload: Any) -> None:
         # transport boundary: count the bytes that actually crossed the
         # wire (encoded size when the worker compressed, raw size
         # otherwise) and decode ONCE, so protocol logic and its logical
         # bytesShipped accounting never see encoded leaves
         self.node.stats.update_stats(bytes_on_wire=payload_size(payload))
+        if op == OP_NACK:
+            self.node.on_nack(worker_id, payload)
+            return
         if self.node.codec is not None:
             payload = self.node.codec.decode(payload)
         self.node.receive(worker_id, op, payload)
+
+    def flush_windows(self) -> None:
+        """Stream quiesce: deliver everything the receive windows still
+        hold (pending gaps will never fill once the stream ended)."""
+        self._quiesced = True
+        if not self._windows:
+            return
+        # snapshot: dispatching a held message can synchronously complete
+        # a round whose release makes a worker push back into receive(),
+        # creating a NEW window mid-iteration
+        for worker_id, window in list(self._windows.items()):
+            for op, payload in window.flush():
+                self._dispatch(worker_id, op, payload)
+
+    def set_parallelism(self, n_workers: int) -> None:
+        """Live rescale: retired workers' receive windows vanish with them
+        (a reused slot restarts its stream at seq 0 against a FRESH
+        window), then the protocol node prunes its own round state."""
+        if self._windows:
+            for w in [w for w in self._windows if w >= n_workers]:
+                del self._windows[w]
+        self.node.set_parallelism(n_workers)
 
     def statistics(self) -> Statistics:
         return self.node.stats
@@ -93,56 +174,112 @@ class HubManager:
     def __init__(self, config: JobConfig, reply_to_spoke: Callable):
         self.config = config
         self.hubs: Dict[Tuple[int, int], Hub] = {}
-        # (network_id, hub_id, worker_id, op, payload)
+        # (network_id, hub_id, worker_id, op, payload, seq)
         self._reply_to_spoke = reply_to_spoke
         self._pre_creation: Dict[Tuple[int, int], DataSet] = {}
+        # per-(network, hub) downstream sequencers (hub->worker streams),
+        # built only for reliability-armed pipelines
+        self._down_seq: Dict[Tuple[int, int], Optional[StreamSequencer]] = {}
+        # cached any-shard-armed flag: the per-record liveness tick on the
+        # data hot path must cost one attribute read when nothing is armed
+        self._any_liveness = False
 
     def create_hub(self, request: Request, hub_id: int, dim: int) -> Hub:
         key = (request.id, hub_id)
         if key in self.hubs:
             return self.hubs[key]
         net_id = request.id
+        armed = reliability_armed(
+            request.training_configuration, channel_chaos_spec(self.config)
+        )
+        seqr = StreamSequencer() if armed else None
+        self._down_seq[key] = seqr
 
         def reply(worker_id: int, op: str, payload: Any) -> None:
-            self._reply_to_spoke(net_id, hub_id, worker_id, op, payload)
+            self._reply_to_spoke(
+                net_id, hub_id, worker_id, op, payload,
+                seqr.next(worker_id) if seqr is not None else None,
+            )
 
         def broadcast(op: str, payload: Any) -> None:
+            # a broadcast is one reliable stream PER destination: each
+            # worker's copy carries that worker's next sequence number
             for w in range(self.config.parallelism):
-                self._reply_to_spoke(net_id, hub_id, w, op, payload)
+                self._reply_to_spoke(
+                    net_id, hub_id, w, op, payload,
+                    seqr.next(w) if seqr is not None else None,
+                )
 
         hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
         self.hubs[key] = hub
+        self._any_liveness = self._any_liveness or hub.node.liveness_armed
         # drain the pre-creation cache (FlinkHub.scala:70-87)
         cached = self._pre_creation.pop(key, None)
         if cached is not None:
-            for worker_id, op, payload in cached:
-                hub.receive(worker_id, op, payload)
+            for worker_id, op, payload, seq in cached:
+                hub.receive(worker_id, op, payload, seq)
         return hub
 
     def set_parallelism(self, n_workers: int) -> None:
         """Live rescale: every PS shard updates its expected worker count
         and drops retired workers' round state (the reference's shared
-        spokeParallelism IntWrapper reaches hub logic the same way)."""
+        spokeParallelism IntWrapper reaches hub logic the same way).
+        Downstream sequencers to retired workers reset too, so a reused
+        slot's stream restarts at seq 0 against the fresh spoke window."""
+        for seqr in self._down_seq.values():
+            if seqr is not None:
+                seqr.drop_streams(
+                    [w for w in seqr._next if isinstance(w, int) and w >= n_workers]
+                )
         for hub in self.hubs.values():
-            hub.node.set_parallelism(n_workers)
+            hub.set_parallelism(n_workers)
 
     def delete_network(self, network_id: int) -> None:
         for key in [k for k in self.hubs if k[0] == network_id]:
             del self.hubs[key]
         for key in [k for k in self._pre_creation if k[0] == network_id]:
             del self._pre_creation[key]
+        for key in [k for k in self._down_seq if k[0] == network_id]:
+            del self._down_seq[key]
+        self._any_liveness = any(
+            h.node.liveness_armed for h in self.hubs.values()
+        )
 
     def route(
-        self, network_id: int, hub_id: int, worker_id: int, op: str, payload: Any
+        self,
+        network_id: int,
+        hub_id: int,
+        worker_id: int,
+        op: str,
+        payload: Any,
+        seq: Optional[int] = None,
     ) -> None:
         hub = self.hubs.get((network_id, hub_id))
         if hub is None:
             cache = self._pre_creation.setdefault(
                 (network_id, hub_id), DataSet(self.config.hub_cache_cap)
             )
-            cache.append((worker_id, op, payload))
+            cache.append((worker_id, op, payload, seq))
             return
-        hub.receive(worker_id, op, payload)
+        hub.receive(worker_id, op, payload, seq)
+
+    def flush_windows(self) -> None:
+        """Quiesce every shard's receive windows (stream end)."""
+        for hub in self.hubs.values():
+            hub.flush_windows()
+
+    def check_liveness(self) -> None:
+        """Clock every liveness-armed shard's worker-deadline check. The
+        job calls this from the DATA path: when a silent worker has the
+        whole fleet blocked on a barrier, no protocol message ever reaches
+        ``Hub.receive`` to run the check — but records keep streaming, so
+        they are the clock that frees the round. One flag read when no
+        pipeline armed liveness (the default hot path)."""
+        if not self._any_liveness:
+            return
+        for hub in self.hubs.values():
+            if hub.node.liveness_armed:
+                hub.node.check_liveness()
 
     def network_statistics(self, network_id: int) -> Optional[Statistics]:
         """Merged cross-hub statistics for one pipeline

@@ -27,6 +27,7 @@ from omldm_tpu.api.stats import JobStatistics
 from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime.control import PipelineManager
 from omldm_tpu.runtime.hub import HubManager
+from omldm_tpu.runtime.messages import channel_chaos_spec
 from omldm_tpu.runtime.responses import ResponseMerger
 from omldm_tpu.runtime.spoke import Spoke, _PauseBuffer
 from omldm_tpu.runtime.stats import StatisticsCollector
@@ -64,15 +65,41 @@ class StreamJob:
         self.pipeline_manager = PipelineManager()
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         self.response_merger = ResponseMerger(self._emit_response)
-        self.hub_manager = HubManager(self.config, self._reply_to_spoke)
+        self.hub_manager = HubManager(self.config, self._ship_to_spoke)
+        # deterministic chaos channel on the in-process hub<->spoke bridge
+        # (JobConfig.chaos / OMLDM_CHAOS): when armed, both directions run
+        # through seeded drop/dup/reorder/delay wrappers, and the reliable
+        # layer (sequence numbers + receive windows + NACK/resync) arms
+        # itself per pipeline to survive it. Unarmed: both attributes stay
+        # None and every route is the exact pre-chaos code path.
+        self._chaos_up = self._chaos_down = None
+        spec_str = channel_chaos_spec(self.config)
+        if spec_str:
+            from omldm_tpu.runtime.supervisor import (
+                ChaosChannel,
+                parse_chaos_spec,
+            )
+
+            spec = parse_chaos_spec(spec_str)
+            self._chaos_up = ChaosChannel.from_spec(
+                self.hub_manager.route, spec, "up", name="spoke>hub"
+            )
+            self._chaos_down = ChaosChannel.from_spec(
+                self._reply_to_spoke, spec, "down", name="hub>spoke"
+            )
+        send_to_hub = (
+            self._chaos_up.send if self._chaos_up is not None
+            else self.hub_manager.route
+        )
         self.spokes: List[Spoke] = [
             Spoke(
                 worker_id=i,
                 config=self.config,
-                send_to_hub=self.hub_manager.route,
+                send_to_hub=send_to_hub,
                 emit_prediction=self._emit_prediction,
                 emit_response=self._route_response_fragment,
                 on_poll=self.stats.mark_activity,
+                note_wire=self._note_wire,
             )
             for i in range(self.config.parallelism)
         ]
@@ -153,12 +180,48 @@ class StreamJob:
         else:
             self.response_merger.add_fragment(frag)
 
+    def _ship_to_spoke(
+        self,
+        network_id: int,
+        hub_id: int,
+        worker_id: int,
+        op: str,
+        payload: Any,
+        seq=None,
+    ) -> None:
+        """Hub->spoke ship boundary: through the chaos channel when armed,
+        straight to delivery otherwise."""
+        if self._chaos_down is not None:
+            self._chaos_down.send(
+                network_id, hub_id, worker_id, op, payload, seq
+            )
+        else:
+            self._reply_to_spoke(network_id, hub_id, worker_id, op, payload, seq)
+
     def _reply_to_spoke(
-        self, network_id: int, hub_id: int, worker_id: int, op: str, payload: Any
+        self,
+        network_id: int,
+        hub_id: int,
+        worker_id: int,
+        op: str,
+        payload: Any,
+        seq=None,
     ) -> None:
         if worker_id >= len(self.spokes):
             return  # addressed to a worker retired by a live rescale
-        self.spokes[worker_id].receive_from_hub(network_id, hub_id, op, payload)
+        self.spokes[worker_id].receive_from_hub(
+            network_id, hub_id, op, payload, seq
+        )
+
+    def _note_wire(
+        self, network_id: int, hub_id: int, counter: str, n: int
+    ) -> None:
+        """Spoke-side reliable-channel events (duplicates dropped, gaps
+        resynced on the hub->worker direction) fold into the pipeline's
+        hub statistics so one report carries both directions."""
+        hub = self.hub_manager.hubs.get((network_id, hub_id))
+        if hub is not None:
+            hub.node.stats.update_stats(**{counter: n})
 
     # --- event handling ---
 
@@ -329,15 +392,20 @@ class StreamJob:
         if n_new < 1:
             raise ValueError(f"parallelism must be >= 1, got {n_new}")
         if n_new > p:
+            send_to_hub = (
+                self._chaos_up.send if self._chaos_up is not None
+                else self.hub_manager.route
+            )
             for w in range(p, n_new):
                 self.spokes.append(
                     Spoke(
                         worker_id=w,
                         config=self.config,
-                        send_to_hub=self.hub_manager.route,
+                        send_to_hub=send_to_hub,
                         emit_prediction=self._emit_prediction,
                         emit_response=self._route_response_fragment,
                         on_poll=self.stats.mark_activity,
+                        note_wire=self._note_wire,
                     )
                 )
             self.config.parallelism = n_new
@@ -395,6 +463,10 @@ class StreamJob:
 
     def _handle_data(self, inst: DataInstance) -> None:
         self.stats.mark_activity()
+        # records are the liveness clock: a silent worker that has every
+        # survivor blocked on a barrier stops ALL protocol traffic, so the
+        # hub-side deadline check must ride the data stream instead
+        self.hub_manager.check_liveness()
         if self._pending_creates:
             pending, self._pending_creates = self._pending_creates, []
             for request in pending:
@@ -427,6 +499,7 @@ class StreamJob:
         if n == 0 or self.stats.terminated:
             return
         self.stats.mark_activity()
+        self.hub_manager.check_liveness()
         if self._pending_creates:
             pending, self._pending_creates = self._pending_creates, []
             for request in pending:
@@ -518,6 +591,16 @@ class StreamJob:
         state, count fragments, normalize, emit JobStatistics."""
         if self.stats.terminated:
             return self.performance[-1] if self.performance else None
+        # the fault window ends at stream end: chaos channels quiesce
+        # (held traffic flushes, later sends pass through — the probe's
+        # final pushes must not be eaten) and receive windows hand back
+        # whatever a never-filled gap was holding
+        for chaos in (self._chaos_up, self._chaos_down):
+            if chaos is not None:
+                chaos.quiesce()
+        for spoke in self.spokes:
+            spoke.flush_rx_windows()
+        self.hub_manager.flush_windows()
         self.stats.probe_fired = True
         for spoke in self.spokes:
             spoke.handle_terminate_probe()

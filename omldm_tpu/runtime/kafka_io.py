@@ -382,8 +382,15 @@ def connect_kafka(
                 for tp, off in ends.items():
                     tracker.setdefault((tp.topic, tp.partition), off)
     producer = _client(KafkaProducer, bootstrap_servers=brokers)
+    # broker-side chaos (OMLDM_CHAOS_KAFKA): seeded drop/dup/reorder on the
+    # consumed record stream — the at-least-once misbehavior a real broker
+    # exhibits across restarts/rebalances, made deterministic for tests.
+    # Unarmed (the default) this returns the consumer untouched.
+    from omldm_tpu.runtime.supervisor import maybe_chaos_consumer
+
+    chaos_consumer = maybe_chaos_consumer(consumer)
     return (
-        polling_events(consumer, topic_map, tracker=tracker),
+        polling_events(chaos_consumer, topic_map, tracker=tracker),
         ProducerSinks(
             producer, out_topics, consumer=consumer, retry=send_retry
         ),

@@ -16,7 +16,8 @@ byte-accounting contract survives: ``get_size`` feeds the protocol statistics
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -34,6 +35,12 @@ OP_QUERY = "query"          # control: model query
 OP_TOGGLE = "toggle"        # pause/resume (FlinkSpoke.scala:130)
 OP_ZETA = "zeta"            # GM/FGM safe-zone traffic
 OP_TERMINATE = "terminate"  # termination probe (networkId == -1)
+# reliable-channel control plane (no reference counterpart: the reference
+# rides Kafka's at-least-once psMessages topic, Job.scala:76-87, and simply
+# tolerates whatever the broker does; here the endpoints detect and repair)
+OP_NACK = "nack"            # receiver -> sender: gap/stall, re-ship state
+OP_RESYNC = "resync"        # authoritative full-state re-ship (resets the
+                            # receiver's window + delta bases for the stream)
 
 
 @dataclasses.dataclass
@@ -83,6 +90,10 @@ class Message:
     destination: Optional[NodeId]
     payload: Any = None
     request: Any = None
+    # per-(networkId, src->dst) monotonic sequence number, stamped by the
+    # reliable-channel layer (None on the default exactly-once in-process
+    # route, where no dedupe/reorder window is armed)
+    seq: Optional[int] = None
 
     def get_size(self) -> int:
         # 16 bytes header (networkId + op id) + ids + payload, matching the
@@ -102,6 +113,9 @@ class BroadcastMessage:
     destinations: Sequence[NodeId]
     payload: Any = None
     request: Any = None
+    # per-destination sequence numbers (one reliable stream per src->dst
+    # pair: a broadcast is N logical point-to-point messages on the wire)
+    seqs: Optional[Sequence[int]] = None
 
     def get_size(self) -> int:
         return 16 + 8 * (1 + len(self.destinations)) + payload_size(self.payload)
@@ -110,6 +124,181 @@ class BroadcastMessage:
         """Expand into per-destination Messages (FlinkLearning.scala:65-75)."""
         return [
             Message(self.network_id, self.operation, self.source, d, self.payload,
-                    self.request)
-            for d in self.destinations
+                    self.request,
+                    self.seqs[i] if self.seqs is not None else None)
+            for i, d in enumerate(self.destinations)
         ]
+
+
+# --- reliable channel: per-stream sequencing + receive windows -------------
+#
+# The reference's PS->worker feedback edge is a Kafka topic (psMessages,
+# Job.scala:76-87,135-142): at-least-once, so messages can be duplicated,
+# delayed, reordered, or replayed after a broker restart. The in-process
+# router is exactly-once BY ACCIDENT of being in-process; the moment a lossy
+# channel (the chaos channel, a real broker) sits between hub and spoke,
+# every protocol needs the dedupe/reorder/resync discipline below. Armed
+# per pipeline (see :func:`reliability_armed`); the default path stamps no
+# sequence numbers and builds no windows — bit-identical to the pre-reliable
+# runtime.
+
+
+class StreamSequencer:
+    """Monotonic per-stream sequence numbers for one sender."""
+
+    def __init__(self) -> None:
+        self._next: Dict[Any, int] = {}
+
+    def next(self, key: Any) -> int:
+        n = self._next.get(key, 0)
+        self._next[key] = n + 1
+        return n
+
+    def drop_streams(self, keys) -> None:
+        """Forget streams (e.g. to retired workers) so a reused slot
+        restarts its stream at seq 0 — matching the fresh window the
+        re-created receiver builds."""
+        for k in list(keys):
+            self._next.pop(k, None)
+
+
+class WindowResult:
+    """Outcome of offering one message to a :class:`ReceiveWindow`."""
+
+    __slots__ = ("deliver", "duplicates", "gap")
+
+    def __init__(self) -> None:
+        self.deliver: List[Tuple[str, Any]] = []  # in-order (op, payload)
+        self.duplicates = 0
+        self.gap = False
+
+
+class ReceiveWindow:
+    """Receive-side dedupe + bounded reorder buffer for ONE stream.
+
+    - duplicates (seq already delivered or already held) are dropped;
+    - out-of-order messages are held until the gap fills, up to ``size``
+      outstanding — within the bound, delivery is in sequence order;
+    - a gap that outlives the bound is declared LOST: the window
+      fast-forwards past it (delivering everything held, in order) and
+      reports ``gap=True`` so the caller can NACK the sender for an
+      authoritative re-ship;
+    - an :data:`OP_RESYNC` message is that re-ship: it supersedes anything
+      held (older by sender order) and restarts the window at its seq.
+    """
+
+    def __init__(self, size: int = 16, passthrough: bool = False):
+        self.size = max(int(size), 1)
+        self.expected = 0
+        self._held: Dict[int, Tuple[str, Any]] = {}
+        # after flush() (stream quiesce) the window passes messages through
+        # immediately: the fault window is over, and holding a probe-time
+        # final push behind a drop-created hole would starve the final
+        # statistics fold. Windows CREATED after the quiesce (first message
+        # from a worker whose every earlier message was lost) start in
+        # pass-through for the same reason.
+        self._passthrough = bool(passthrough)
+        # cumulative per-window counters (mirrored into Statistics by the
+        # runtime endpoints that own the window)
+        self.duplicates_dropped = 0
+        self.gaps_resynced = 0
+
+    def __len__(self) -> int:
+        return len(self._held)
+
+    def offer(self, seq: int, op: str, payload: Any) -> WindowResult:
+        res = WindowResult()
+        if self._passthrough:
+            if seq < self.expected:
+                res.duplicates = 1
+                self.duplicates_dropped += 1
+            else:
+                self.expected = seq + 1
+                res.deliver.append((op, payload))
+            return res
+        # duplicate check FIRST, for resyncs too: a late duplicate of an
+        # already-processed resync (dup chaos delivers held copies late)
+        # must not rewind the window onto stale state
+        if seq < self.expected or seq in self._held:
+            res.duplicates = 1
+            self.duplicates_dropped += 1
+            return res
+        if op == OP_RESYNC:
+            # authoritative full-state re-ship: anything still held was
+            # sent BEFORE it (sender-order) and is superseded
+            self._held.clear()
+            self.expected = seq + 1
+            res.deliver.append((op, payload))
+            return res
+        if seq == self.expected:
+            res.deliver.append((op, payload))
+            self.expected = seq + 1
+            while self.expected in self._held:
+                res.deliver.append(self._held.pop(self.expected))
+                self.expected += 1
+            return res
+        # out of order: hold, or declare the gap lost once past the bound
+        self._held[seq] = (op, payload)
+        if seq - self.expected > self.size or len(self._held) > self.size:
+            res.gap = True
+            self.gaps_resynced += 1
+            for s in sorted(self._held):
+                res.deliver.append(self._held[s])
+            self.expected = max(self._held) + 1
+            self._held.clear()
+        return res
+
+    def flush(self) -> List[Tuple[str, Any]]:
+        """Quiesce: hand back everything held, in sequence order (stream
+        end — pending gaps are never going to fill), and switch the window
+        to pass-through for whatever the termination protocol still
+        sends."""
+        out = [self._held[s] for s in sorted(self._held)]
+        if self._held:
+            self.expected = max(self._held) + 1
+        self._held.clear()
+        self._passthrough = True
+        return out
+
+
+# --- reliability configuration (trainingConfiguration.comm.*) --------------
+
+DEFAULT_WINDOW_SIZE = 16
+# batches a blocked worker buffers before it suspects a lost message and
+# re-fires its pending exchange (stall watchdog; only armed with the
+# reliable channel — healthy in-process rounds resolve within a couple of
+# batches, see tests/test_protocols.py::TestSynchronous, and a spurious
+# firing is harmless: the NACK/re-push pair is idempotent)
+DEFAULT_STALL_AFTER = 16
+
+
+def comm_dict(tc) -> dict:
+    """The ``trainingConfiguration.comm`` table (empty when absent)."""
+    extra = getattr(tc, "extra", None) or {}
+    return extra.get("comm") or {}
+
+
+def channel_chaos_spec(config) -> str:
+    """The job's chaos-channel spec: ``JobConfig.chaos`` flag, else the
+    ``OMLDM_CHAOS`` environment variable (the env route reaches worker
+    subprocesses that only see CLI flags)."""
+    return getattr(config, "chaos", "") or os.environ.get("OMLDM_CHAOS", "")
+
+
+def reliability_armed(tc, chaos_spec: str = "") -> bool:
+    """Whether the hub<->spoke channel for this pipeline runs the reliable
+    layer (sequence stamping + receive windows + NACK/resync).
+
+    Explicit ``comm.reliable`` wins; otherwise the layer arms itself when
+    the channel is actually lossy (a chaos spec is active) or when quorum
+    release is configured (its retire/re-admit path rides resync). With
+    none of those, nothing is stamped and every route is bit-identical to
+    the pre-reliable runtime."""
+    comm = comm_dict(tc)
+    if "reliable" in comm:
+        return bool(comm["reliable"])
+    return bool(chaos_spec) or comm.get("quorum") is not None
+
+
+def channel_window_size(tc) -> int:
+    return int(comm_dict(tc).get("windowSize", DEFAULT_WINDOW_SIZE))

@@ -31,6 +31,14 @@ from omldm_tpu.config import JobConfig
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
 from omldm_tpu.runtime.databuffers import DataSet
+from omldm_tpu.runtime.messages import (
+    OP_NACK,
+    ReceiveWindow,
+    StreamSequencer,
+    channel_chaos_spec,
+    channel_window_size,
+    reliability_armed,
+)
 from omldm_tpu.runtime.vectorizer import (
     MicroBatcher,
     SparseMicroBatcher,
@@ -150,6 +158,16 @@ class SpokeNet:
         self.node = make_worker_node(
             self.protocol, pipeline, worker_id, n_workers, tc, send
         )
+        # reliable channel (lossy-channel hardening): per-hub outgoing
+        # sequence numbers + per-hub receive windows, armed per pipeline.
+        # Unarmed (the default), nothing is stamped or windowed and the
+        # routes are bit-identical to the pre-reliable runtime.
+        self.channel_armed = reliability_armed(tc, channel_chaos_spec(config))
+        self.node.channel_armed = self.channel_armed
+        self._window_size = channel_window_size(tc)
+        self._tx_seq = StreamSequencer() if self.channel_armed else None
+        self._rx_windows: Dict[int, ReceiveWindow] = {}
+        self._quiesced = False
         self.test_set: DataSet[Tuple[np.ndarray, float]] = DataSet(
             config.test_set_size
         )
@@ -159,6 +177,21 @@ class SpokeNet:
         # reference's BufferingWrapper holds tuples the same way; beyond
         # the row cap the oldest rows drop (keep-newest eviction)
         self.pause_buffer = _PauseBuffer(config.record_buffer_cap)
+
+    def next_seq(self, hub_id: int) -> Optional[int]:
+        if self._tx_seq is None:
+            return None
+        return self._tx_seq.next(hub_id)
+
+    def rx_window(self, hub_id: int) -> ReceiveWindow:
+        window = self._rx_windows.get(hub_id)
+        if window is None:
+            # post-quiesce windows start in pass-through: the first-ever
+            # message from this hub may arrive during termination
+            window = self._rx_windows[hub_id] = ReceiveWindow(
+                self._window_size, passthrough=self._quiesced
+            )
+        return window
 
     @property
     def pipeline(self) -> MLPipeline:
@@ -192,10 +225,11 @@ class Spoke:
         self,
         worker_id: int,
         config: JobConfig,
-        send_to_hub: Callable,   # (network_id, hub_id, worker_id, op, payload)
+        send_to_hub: Callable,   # (network_id, hub_id, worker_id, op, payload, seq)
         emit_prediction: Callable[[Prediction], None],
         emit_response: Callable[[QueryResponse], None],
         on_poll: Callable[[], None],
+        note_wire: Optional[Callable[[int, int, str, int], None]] = None,
     ):
         self.worker_id = worker_id
         self.config = config
@@ -204,6 +238,10 @@ class Spoke:
         self._emit_prediction = emit_prediction
         self._emit_response = emit_response
         self._on_poll = on_poll
+        # spoke-side reliable-channel events (duplicates dropped, gaps
+        # resynced) fold into the pipeline's hub statistics through this
+        # job-provided callback: (network_id, hub_id, counter_name, n)
+        self._note_wire = note_wire
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
@@ -259,7 +297,14 @@ class Spoke:
 
     def _make_send(self, network_id: int):
         def send(op: str, payload: Any, hub_id: int = 0) -> None:
-            self._send_to_hub(network_id, hub_id, self.worker_id, op, payload)
+            # reliable channel: stamp the per-(net, worker->hub) sequence
+            # number at the true ship boundary (below the codec wrapper,
+            # above the possibly-lossy router)
+            net = self.nets.get(network_id)
+            seq = net.next_seq(hub_id) if net is not None else None
+            self._send_to_hub(
+                network_id, hub_id, self.worker_id, op, payload, seq
+            )
 
         return send
 
@@ -537,11 +582,42 @@ class Spoke:
             self.emit_query_response(net, TERMINATION_RESPONSE_ID)
 
     def receive_from_hub(
-        self, network_id: int, hub_id: int, op: str, payload: Any
+        self,
+        network_id: int,
+        hub_id: int,
+        op: str,
+        payload: Any,
+        seq: Optional[int] = None,
     ) -> None:
         net = self.nets.get(network_id)
         if net is None:
             return
+        if seq is None or not net.channel_armed:
+            self._deliver_from_hub(net, network_id, hub_id, op, payload)
+            return
+        # reliable channel: dedupe/reorder through the per-hub window; a
+        # gap past the window NACKs the hub for an authoritative resync
+        # and drops the codec's receive bases for this hub's streams (the
+        # lost deltas desynced them; the resync/re-anchor realigns)
+        window = net.rx_window(hub_id)
+        res = window.offer(seq, op, payload)
+        if res.duplicates and self._note_wire is not None:
+            self._note_wire(
+                network_id, hub_id, "duplicates_dropped", res.duplicates
+            )
+        if res.gap:
+            if self._note_wire is not None:
+                self._note_wire(network_id, hub_id, "gaps_resynced", 1)
+            if net.node.codec is not None:
+                net.node.codec.reset_rx_stream(f"h{hub_id}>w{self.worker_id}")
+                net.node.codec.reset_rx_stream(f"h{hub_id}>*")
+            net.node.send(OP_NACK, {"gap": True}, hub_id)
+        for d_op, d_payload in res.deliver:
+            self._deliver_from_hub(net, network_id, hub_id, d_op, d_payload)
+
+    def _deliver_from_hub(
+        self, net: SpokeNet, network_id: int, hub_id: int, op: str, payload: Any
+    ) -> None:
         # deliver() is the worker-side decode boundary (transport codec)
         net.node.deliver(op, payload, hub_id)
         # cooperative multi-pipeline fairness: every hub RPC for one net
@@ -554,6 +630,18 @@ class Spoke:
             other.node.toggle()
             if not other.node.paused:
                 self._drain_pause_buffer(other)
+
+    def flush_rx_windows(self) -> None:
+        """Stream quiesce: deliver everything the receive windows still
+        hold — their gaps will never fill once the stream ended.
+        Snapshots both dicts: a delivered release can synchronously drain
+        blocked batches, push, and make the hub reply into a window (or
+        net) not yet visited."""
+        for network_id, net in list(self.nets.items()):
+            net._quiesced = True
+            for hub_id, window in list(net._rx_windows.items()):
+                for op, payload in window.flush():
+                    self._deliver_from_hub(net, network_id, hub_id, op, payload)
 
     def _process_packed_for_net(self, net, x, y, f_idx) -> None:
         """One net's share of a packed block: serve each forecast at its

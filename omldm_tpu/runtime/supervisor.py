@@ -498,10 +498,279 @@ class DistributedFaultInjector:
             )
 
 
+# --- deterministic chaos channel -------------------------------------------
+#
+# The process-level injector above kills workers and corrupts disks; the
+# CHANNEL-level half below makes the message fabric itself misbehave the way
+# the reference's Kafka psMessages edge can (at-least-once: duplicated,
+# delayed, reordered, or lost messages — Job.scala:76-87). Everything is
+# seeded and counted, so tests assert exact schedules and convergence
+# envelopes instead of hoping.
+
+_CHAOS_PARAMS = ("drop", "dup", "reorder", "delay")
+
+
+def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
+    """Parse a chaos spec string into ``{seed, window, up: {...}, down:
+    {...}}``.
+
+    Format: comma-separated ``key=value`` pairs. ``seed`` and ``window``
+    are channel-wide; ``drop``/``dup``/``reorder``/``delay`` are
+    probabilities applied to BOTH directions unless prefixed
+    (``up.drop=0.1`` hits only worker->hub, ``down.dup=0.05`` only
+    hub->worker). Returns None for an empty/None spec; raises ValueError
+    on unknown keys so a typo'd flag fails loudly instead of running
+    fault-free."""
+    if not spec:
+        return None
+    base = {k: 0.0 for k in _CHAOS_PARAMS}
+    out: Dict = {"seed": 0, "window": 4, "up": dict(base), "down": dict(base)}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        value = value.strip() or "0"
+        if key in ("seed", "window"):
+            out[key] = int(float(value))
+        elif "." in key:
+            direction, _, param = key.partition(".")
+            if direction not in ("up", "down") or param not in _CHAOS_PARAMS:
+                raise ValueError(f"unknown chaos key {key!r}")
+            out[direction][param] = float(value)
+        elif key in _CHAOS_PARAMS:
+            out["up"][key] = out["down"][key] = float(value)
+        else:
+            raise ValueError(f"unknown chaos key {key!r}")
+    return out
+
+
+def _chaos_rng(seed: int, name: str):
+    import zlib
+
+    import numpy as np
+
+    # stable per-channel stream: python's hash() is salted per process,
+    # crc32 is not — same (seed, name) => same schedule, everywhere
+    return np.random.RandomState(
+        (int(seed) ^ zlib.crc32(name.encode())) & 0x7FFFFFFF
+    )
+
+
+class ChaosChannel:
+    """Seeded lossy wrapper around a deliver callable (the in-process
+    hub<->spoke bridge).
+
+    Every :meth:`send` draws an independent fate per fault class from the
+    channel's private RNG, so the drop/dup/reorder/delay schedule is a pure
+    function of ``(seed, name, call sequence)`` — deterministic, replayable,
+    assertable. Held messages (reordered / delayed / duplicate copies)
+    release after 1..window subsequent sends pass, preserving bounded
+    reordering. ``quiesce()`` ends the fault window: held traffic flushes
+    and later sends pass through untouched (stream-end must not eat final
+    state pushes)."""
+
+    def __init__(
+        self,
+        deliver,
+        *,
+        seed: int = 0,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        reorder: float = 0.0,
+        delay: float = 0.0,
+        window: int = 4,
+        name: str = "chan",
+    ):
+        self._deliver = deliver
+        self._rng = _chaos_rng(seed, name)
+        self.drop = float(drop)
+        self.dup = float(dup)
+        self.reorder = float(reorder)
+        self.delay = float(delay)
+        self.window = max(int(window), 1)
+        self.name = name
+        self.active = True
+        self._held: List[list] = []  # [countdown, args]
+        self.sent = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.reordered = 0
+
+    @classmethod
+    def from_spec(cls, deliver, spec: Dict, direction: str, name: str = ""):
+        return cls(
+            deliver,
+            seed=spec["seed"],
+            window=spec["window"],
+            name=name or direction,
+            **spec[direction],
+        )
+
+    def send(self, *args) -> None:
+        self.sent += 1
+        if not self.active:
+            self.delivered += 1
+            self._deliver(*args)
+            return
+        u_drop, u_dup, u_reorder, u_delay = self._rng.random_sample(4)
+        if u_drop < self.drop:
+            self.dropped += 1
+        elif u_reorder < self.reorder or u_delay < self.delay:
+            self._held.append([int(self._rng.randint(1, self.window + 1)), args])
+            self.reordered += 1
+        else:
+            self.delivered += 1
+            self._deliver(*args)
+        if u_dup < self.dup:
+            # the duplicate copy arrives LATE (held like a reordered
+            # message): receivers must survive out-of-order duplicates,
+            # not just back-to-back ones
+            self._held.append([int(self._rng.randint(1, self.window + 1)), args])
+            self.duplicated += 1
+        self._tick()
+
+    def _tick(self) -> None:
+        for h in self._held:
+            h[0] -= 1
+        # pop-one-at-a-time: delivering may recurse into send() and mutate
+        # the queue (in-process routing is synchronous)
+        while True:
+            due = next((h for h in self._held if h[0] <= 0), None)
+            if due is None:
+                return
+            self._held.remove(due)
+            self.delivered += 1
+            self._deliver(*due[1])
+
+    def flush(self) -> None:
+        """Deliver everything held, in hold order."""
+        while self._held:
+            _, args = self._held.pop(0)
+            self.delivered += 1
+            self._deliver(*args)
+
+    def quiesce(self) -> None:
+        """End the fault window (stream end / termination probe)."""
+        self.active = False
+        self.flush()
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "sent": self.sent,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "duplicated": self.duplicated,
+            "reordered": self.reordered,
+        }
+
+
+class ChaosConsumer:
+    """Seeded lossy wrapper around a Kafka-style consumer iterator.
+
+    Applies drop/dup/reorder to the RECORD stream (the broker-side faults
+    of an at-least-once source: redelivery after rebalance, replayed
+    batches after restart). Drops model transient loss before commit —
+    offsets of dropped records are never recorded, so a checkpoint/restore
+    cycle re-reads them: at-least-once is preserved, exactly what the
+    reference's Kafka sources guarantee. All non-iterator attributes
+    (assign/seek/position/...) delegate to the wrapped consumer."""
+
+    def __init__(self, inner, *, seed: int = 0, drop: float = 0.0,
+                 dup: float = 0.0, reorder: float = 0.0, delay: float = 0.0,
+                 window: int = 4, name: str = "kafka"):
+        self._inner = inner
+        self._rng = _chaos_rng(seed, name)
+        self._drop = float(drop)
+        self._dup = float(dup)
+        self._reorder = float(reorder + delay)
+        self._window = max(int(window), 1)
+        self._held: List[list] = []  # [countdown, record]
+        self.dropped = 0
+        self.duplicated = 0
+        self.reordered = 0
+
+    def __iter__(self):
+        return self
+
+    def _due(self):
+        due = next((h for h in self._held if h[0] <= 0), None)
+        if due is not None:
+            self._held.remove(due)
+        return due
+
+    def __next__(self):
+        while True:
+            due = self._due()
+            if due is not None:
+                return due[1]
+            try:
+                rec = next(self._inner)
+            except StopIteration:
+                # idle window: release held records (nothing left for them
+                # to reorder past) before going idle ourselves
+                if self._held:
+                    return self._held.pop(0)[1]
+                raise
+            for h in self._held:
+                h[0] -= 1
+            u_drop, u_dup, u_reorder = self._rng.random_sample(3)
+            if u_dup < self._dup:
+                self._held.append(
+                    [int(self._rng.randint(1, self._window + 1)), rec]
+                )
+                self.duplicated += 1
+            if u_drop < self._drop:
+                self.dropped += 1
+                continue
+            if u_reorder < self._reorder:
+                self._held.append(
+                    [int(self._rng.randint(1, self._window + 1)), rec]
+                )
+                self.reordered += 1
+                continue
+            return rec
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def maybe_chaos_consumer(
+    consumer,
+    flags: Optional[Dict[str, str]] = None,
+    env_var: str = "OMLDM_CHAOS_KAFKA",
+    name: str = "kafka",
+):
+    """Wrap ``consumer`` in a :class:`ChaosConsumer` when broker chaos is
+    armed (``--kafkaChaos`` flag or the env var, which reaches supervised
+    worker subprocesses); otherwise return it untouched."""
+    spec_str = (flags or {}).get("kafkaChaos") or os.environ.get(env_var, "")
+    spec = parse_chaos_spec(spec_str)
+    if spec is None:
+        return consumer
+    params = spec["up"]
+    if not any(params.values()):
+        return consumer
+    print(
+        f"[chaos] kafka consumer chaos armed: seed={spec['seed']} {params}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return ChaosConsumer(
+        consumer, seed=spec["seed"], window=spec["window"], name=name, **params
+    )
+
+
 __all__ = [
     "AttemptRecord",
+    "ChaosChannel",
+    "ChaosConsumer",
     "DistributedFaultInjector",
     "DistributedJobSupervisor",
     "FleetFailure",
+    "maybe_chaos_consumer",
+    "parse_chaos_spec",
     "supervise_from_flags",
 ]

@@ -1,0 +1,641 @@
+"""Lossy-channel hardening: reliable hub<->spoke transport, hub-side worker
+liveness with quorum round release, and the deterministic chaos channel.
+
+The reference's PS->worker feedback edge rides Kafka (psMessages,
+Job.scala:76-87,135-142) — at-least-once, so messages duplicate, reorder,
+delay, and vanish on broker restarts. These tests pin the hardening layer:
+per-stream sequence numbers + receive windows (dedupe / bounded reorder /
+gap->NACK->resync), hub-side worker-deadline clocks with k-of-n quorum
+round release, and the seeded ChaosChannel that makes every fault schedule
+a pure function of (seed, name, call sequence).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.codec import TransportCodec
+from omldm_tpu.runtime.job import REQUEST_STREAM, TRAINING_STREAM
+from omldm_tpu.runtime.messages import (
+    OP_RESYNC,
+    ReceiveWindow,
+    StreamSequencer,
+    reliability_armed,
+)
+from omldm_tpu.runtime.supervisor import (
+    ChaosChannel,
+    ChaosConsumer,
+    parse_chaos_spec,
+)
+
+# the acceptance operating point (ISSUE 4): 5% drop, 5% dup, reorder
+# window 4, both directions
+ACCEPTANCE_CHAOS = "seed=7,drop=0.05,dup=0.05,reorder=0.1,window=4"
+
+PARAM_PROTOCOLS = ["Asynchronous", "Synchronous", "SSP", "EASGD", "GM", "FGM"]
+
+
+def stream_lines(n, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim)
+    y = (x @ w > 0).astype(np.float64)
+    return [
+        json.dumps(
+            {"numericalFeatures": list(np.round(x[i], 5)), "target": float(y[i])}
+        )
+        for i in range(n)
+    ]
+
+
+def run_protocol(protocol, n=2500, parallelism=4, chaos="", comm=None,
+                 extra=None, lines=None):
+    cfg = JobConfig(
+        parallelism=parallelism, batch_size=32, test_set_size=32, chaos=chaos
+    )
+    job = StreamJob(cfg)
+    tc = {"protocol": protocol, "syncEvery": 2}
+    if comm is not None:
+        tc["comm"] = comm
+    if extra:
+        tc.update(extra)
+    create = {
+        "id": 0,
+        "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+        "trainingConfiguration": tc,
+    }
+    events = [(REQUEST_STREAM, json.dumps(create))] + [
+        (TRAINING_STREAM, l) for l in (lines or stream_lines(n))
+    ]
+    report = job.run(events)
+    assert report is not None, f"{protocol}: no job statistics emitted"
+    [stats] = report.statistics
+    return job, stats
+
+
+# --- unit: sequencer + receive window ---------------------------------------
+
+
+class TestStreamSequencer:
+    def test_monotonic_per_stream(self):
+        s = StreamSequencer()
+        assert [s.next("a"), s.next("a"), s.next("b"), s.next("a")] == [0, 1, 0, 2]
+
+    def test_drop_streams_restarts_at_zero(self):
+        s = StreamSequencer()
+        s.next(3), s.next(3), s.next(1)
+        s.drop_streams([3])
+        assert s.next(3) == 0
+        assert s.next(1) == 1
+
+
+class TestReceiveWindow:
+    def test_in_order_passthrough(self):
+        w = ReceiveWindow(4)
+        for i in range(5):
+            res = w.offer(i, "op", i)
+            assert res.deliver == [("op", i)]
+            assert not res.gap and not res.duplicates
+
+    def test_duplicates_dropped(self):
+        w = ReceiveWindow(4)
+        w.offer(0, "op", "a")
+        res = w.offer(0, "op", "a")
+        assert res.deliver == [] and res.duplicates == 1
+        # duplicate of a HELD (not yet delivered) message drops too
+        w.offer(2, "op", "c")
+        res = w.offer(2, "op", "c")
+        assert res.duplicates == 1
+        assert w.duplicates_dropped == 2
+
+    def test_reorder_within_window(self):
+        w = ReceiveWindow(4)
+        assert w.offer(1, "op", "b").deliver == []
+        assert w.offer(2, "op", "c").deliver == []
+        res = w.offer(0, "op", "a")
+        assert res.deliver == [("op", "a"), ("op", "b"), ("op", "c")]
+        assert w.expected == 3
+
+    def test_gap_fast_forward_and_flag(self):
+        w = ReceiveWindow(2)
+        w.offer(0, "op", "a")
+        # seq 1 lost; 2, 3 hold; 4 breaches the window => gap declared,
+        # held messages deliver in order and the window skips the hole
+        assert w.offer(2, "op", "c").deliver == []
+        assert w.offer(3, "op", "d").gap is False
+        res = w.offer(4, "op", "e")
+        assert res.gap is True
+        assert res.deliver == [("op", "c"), ("op", "d"), ("op", "e")]
+        assert w.expected == 5
+        assert w.gaps_resynced == 1
+
+    def test_resync_supersedes_held(self):
+        w = ReceiveWindow(8)
+        w.offer(0, "op", "a")
+        w.offer(3, "op", "stale-held")
+        res = w.offer(5, OP_RESYNC, {"params": 1})
+        assert res.deliver == [(OP_RESYNC, {"params": 1})]
+        assert w.expected == 6
+        # the held pre-resync message is gone; later traffic flows in order
+        assert w.offer(6, "op", "f").deliver == [("op", "f")]
+
+    def test_stale_duplicate_resync_does_not_rewind(self):
+        """A late DUPLICATE of an already-processed resync (dup chaos
+        delivers held copies late) must drop like any duplicate — not
+        rewind the window onto stale state."""
+        w = ReceiveWindow(8)
+        w.offer(5, OP_RESYNC, {"params": "fresh"})
+        for s in range(6, 10):
+            w.offer(s, "op", s)
+        res = w.offer(5, OP_RESYNC, {"params": "fresh"})
+        assert res.duplicates == 1 and res.deliver == []
+        assert w.expected == 10
+
+    def test_window_born_in_passthrough_delivers_immediately(self):
+        """A window created after stream quiesce (first-ever message from
+        a peer whose earlier traffic was all lost) must not hold the
+        terminate-time push behind its zero expectation."""
+        w = ReceiveWindow(8, passthrough=True)
+        assert w.offer(3, "op", "late-final-push").deliver == [
+            ("op", "late-final-push")
+        ]
+
+    def test_flush_then_passthrough(self):
+        w = ReceiveWindow(8)
+        w.offer(0, "op", "a")
+        w.offer(2, "op", "c")
+        assert w.flush() == [("op", "c")]
+        # post-quiesce: messages pass through even over holes...
+        assert w.offer(7, "op", "h").deliver == [("op", "h")]
+        # ...but stale duplicates still drop
+        assert w.offer(2, "op", "c").duplicates == 1
+
+
+# --- unit: chaos channel determinism ----------------------------------------
+
+
+class TestChaosSpec:
+    def test_parse_directions_and_defaults(self):
+        spec = parse_chaos_spec("seed=9,drop=0.1,up.dup=0.2,window=6")
+        assert spec["seed"] == 9 and spec["window"] == 6
+        assert spec["up"] == {"drop": 0.1, "dup": 0.2, "reorder": 0.0, "delay": 0.0}
+        assert spec["down"]["dup"] == 0.0 and spec["down"]["drop"] == 0.1
+        assert parse_chaos_spec("") is None
+        with pytest.raises(ValueError):
+            parse_chaos_spec("dorp=0.1")
+
+
+class TestChaosChannelDeterminism:
+    def _schedule(self, seed, n=300, **params):
+        out = []
+        chan = ChaosChannel(
+            lambda *args: out.append(args), seed=seed, name="t", **params
+        )
+        for i in range(n):
+            chan.send(i)
+        chan.quiesce()
+        return out, chan.counters()
+
+    def test_same_seed_identical_schedule(self):
+        """Satellite: same seed => identical drop/dup/reorder schedule,
+        down to the exact delivery order."""
+        a, ca = self._schedule(7, drop=0.1, dup=0.1, reorder=0.2, window=4)
+        b, cb = self._schedule(7, drop=0.1, dup=0.1, reorder=0.2, window=4)
+        assert a == b
+        assert ca == cb
+        assert ca["dropped"] > 0 and ca["duplicated"] > 0 and ca["reordered"] > 0
+
+    def test_different_seed_different_schedule(self):
+        a, _ = self._schedule(7, drop=0.1, dup=0.1, reorder=0.2, window=4)
+        b, _ = self._schedule(8, drop=0.1, dup=0.1, reorder=0.2, window=4)
+        assert a != b
+
+    def test_conservation_without_drop(self):
+        """dup/reorder-only chaos conserves (and adds) messages — nothing
+        vanishes once the channel quiesces."""
+        out, c = self._schedule(3, dup=0.2, reorder=0.3, window=4)
+        assert len(out) == 300 + c["duplicated"]
+        assert sorted(m[0] for m in set(out)) == list(range(300))
+
+    def test_zero_probabilities_pass_through_in_order(self):
+        out, c = self._schedule(5)
+        assert [m[0] for m in out] == list(range(300))
+        assert c["dropped"] == c["duplicated"] == c["reordered"] == 0
+
+    def test_quiesce_flushes_and_disables(self):
+        out = []
+        chan = ChaosChannel(
+            lambda *a: out.append(a), seed=1, drop=1.0, name="q"
+        )
+        chan.send("eaten")
+        chan.quiesce()
+        chan.send("after")
+        assert out == [("after",)]
+
+    def test_consumer_same_seed_same_schedule(self):
+        def records():
+            return iter(range(200))
+
+        def consume(seed):
+            out, chaos = [], ChaosConsumer(
+                records(), seed=seed, drop=0.1, dup=0.15, reorder=0.2
+            )
+            for rec in chaos:
+                out.append(rec)
+            return out
+
+        assert consume(4) == consume(4)
+        assert consume(4) != consume(5)
+
+
+# --- the reliable layer is transparent when nothing misbehaves ---------------
+
+
+class TestReliableTransparency:
+    @pytest.mark.parametrize("protocol", ["Synchronous", "SSP", "FGM"])
+    def test_armed_faultless_is_bit_identical(self, protocol):
+        """comm.reliable=true with a clean channel must not change a single
+        statistic: sequence stamping, windows, and watchdogs are invisible
+        until something actually goes wrong."""
+        lines = stream_lines(2500)
+        _, base = run_protocol(protocol, lines=lines)
+        _, armed = run_protocol(protocol, comm={"reliable": True}, lines=lines)
+        assert base.to_dict() == armed.to_dict()
+
+    def test_reliability_arming_rules(self):
+        tc = TrainingConfiguration(protocol="Synchronous")
+        assert not reliability_armed(tc, "")
+        assert reliability_armed(tc, "seed=1,drop=0.1")
+        tc_q = TrainingConfiguration(
+            protocol="Synchronous", extra={"comm": {"quorum": 2}}
+        )
+        assert reliability_armed(tc_q, "")
+        tc_off = TrainingConfiguration(
+            protocol="Synchronous", extra={"comm": {"reliable": False}}
+        )
+        assert not reliability_armed(tc_off, "seed=1,drop=0.1")
+
+
+# --- duplicate-delivery idempotence (all parameter protocols) ----------------
+
+
+class TestDuplicateIdempotence:
+    @pytest.mark.parametrize("protocol", PARAM_PROTOCOLS)
+    def test_dup_only_chaos_is_bit_identical(self, protocol):
+        """Satellite: under dup-ONLY chaos (nothing lost, nothing
+        reordered away — duplicates arrive late but every original arrives
+        on time) the receive windows drop every duplicate, so the stats are
+        BIT-IDENTICAL to the fault-free run except for the duplicate
+        counter itself."""
+        lines = stream_lines(2500)
+        _, clean = run_protocol(protocol, comm={"reliable": True}, lines=lines)
+        _, dup = run_protocol(
+            protocol, chaos="seed=3,dup=0.3,window=4", lines=lines
+        )
+        d_clean, d_dup = clean.to_dict(), dup.to_dict()
+        dropped = d_dup.pop("duplicatesDropped")
+        d_clean.pop("duplicatesDropped")
+        assert dropped > 0, f"{protocol}: no duplicates delivered (seed too kind?)"
+        assert d_clean == d_dup
+
+
+# --- gap -> NACK -> resync ---------------------------------------------------
+
+
+class TestGapResync:
+    def test_drop_chaos_triggers_resync_and_converges(self):
+        """Heavy drop chaos with a tight receive window forces gap
+        declarations; the NACK/resync cycle must both fire (counter) and
+        repair (score)."""
+        job, stats = run_protocol(
+            "Asynchronous",
+            chaos="seed=11,drop=0.2,window=2",
+            comm={"windowSize": 2},
+            extra={"syncEvery": 1},
+        )
+        assert stats.gaps_resynced > 0
+        assert stats.score > 0.8
+
+    def test_blocking_protocol_survives_heavy_loss(self):
+        """BSP under 20% drop: lost pushes and lost releases both stall
+        rounds; the stall watchdog's NACK/re-push and the hub resync must
+        keep the job moving to a converged model with zero crashes."""
+        job, stats = run_protocol(
+            "Synchronous",
+            chaos="seed=13,drop=0.2,window=4",
+            comm={"windowSize": 4, "stallAfter": 4},
+            extra={"syncEvery": 1},
+        )
+        assert stats.score > 0.8
+
+
+# --- acceptance: convergence under the ISSUE operating point -----------------
+
+
+class TestChaosConvergence:
+    @pytest.mark.parametrize("protocol", PARAM_PROTOCOLS)
+    def test_protocol_converges_under_seeded_chaos(self, protocol):
+        """Acceptance: drop=0.05, dup=0.05, reorder window 4 => every
+        parameter protocol finishes (zero crashes) with the final holdout
+        score within 5% of the fault-free run."""
+        lines = stream_lines(2500)
+        _, clean = run_protocol(protocol, lines=lines)
+        _, chaotic = run_protocol(protocol, chaos=ACCEPTANCE_CHAOS, lines=lines)
+        assert chaotic.score > 0.0
+        assert abs(chaotic.score - clean.score) <= 0.05, (
+            f"{protocol}: chaos score {chaotic.score} vs clean {clean.score}"
+        )
+
+
+# --- hub-side liveness: quorum round release + re-admission ------------------
+
+
+def _silent_worker_job(protocol="Synchronous", parallelism=3, quorum=2,
+                       timeout_ms=1000, extra=None):
+    job = StreamJob(
+        JobConfig(parallelism=parallelism, batch_size=16, test_set_size=16)
+    )
+    tc = {
+        "protocol": protocol,
+        "syncEvery": 1,
+        "comm": {"quorum": quorum, "workerTimeoutMs": timeout_ms},
+    }
+    if extra:
+        tc.update(extra)
+    create = {
+        "id": 0,
+        "request": "Create",
+        "learner": {
+            "name": "PA",
+            "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": 6},
+        },
+        "trainingConfiguration": tc,
+    }
+    job.process_event(REQUEST_STREAM, json.dumps(create))
+    hub = job.hub_manager.hubs[(0, 0)].node
+    now = [0.0]
+    hub._clock = lambda: now[0]
+    return job, hub, now
+
+
+class TestQuorumRelease:
+    def test_bsp_round_releases_on_quorum_within_timeout(self):
+        """Acceptance: a BSP round with one silent worker releases via
+        quorum once comm.workerTimeoutMs elapses — the survivors unblock
+        and keep training instead of buffering forever."""
+        job, hub, now = _silent_worker_job()
+        silent = job.spokes[2].nets[0]
+        silent.node.send = lambda *a, **k: None  # dead NIC
+        lines = stream_lines(600)
+        for l in lines[:300]:
+            job.process_event(TRAINING_STREAM, l)
+        w0 = job.spokes[0].nets[0].node
+        assert w0.waiting, "precondition: survivors blocked on the silent worker"
+        assert hub.stats.quorum_releases == 0
+        fitted_before = job.spokes[0].nets[0].pipeline.fitted
+
+        now[0] = 2.0  # past the 1s deadline; records are the clock
+        for l in lines[300:]:
+            job.process_event(TRAINING_STREAM, l)
+        assert hub._retired_live == {2}
+        assert hub.stats.quorum_releases > 0
+        assert not w0.waiting
+        assert job.spokes[0].nets[0].pipeline.fitted > fitted_before
+
+    def test_silent_worker_readmitted_as_fresh_join(self):
+        """A retired worker that speaks again is re-admitted: barriers
+        count it once more and it is caught up with an authoritative
+        resync (the fresh-join seed)."""
+        job, hub, now = _silent_worker_job()
+        silent = job.spokes[2].nets[0]
+        real_send = silent.node.send
+        silent.node.send = lambda *a, **k: None
+        lines = stream_lines(900, seed=2)
+        for l in lines[:300]:
+            job.process_event(TRAINING_STREAM, l)
+        now[0] = 2.0
+        for l in lines[300:600]:
+            job.process_event(TRAINING_STREAM, l)
+        assert hub._retired_live == {2}
+
+        silent.node.send = real_send  # the worker comes back
+        fitted_back = silent.pipeline.fitted
+        for l in lines[600:]:
+            job.process_event(TRAINING_STREAM, l)
+        assert hub._retired_live == set()
+        assert silent.pipeline.fitted > fitted_back
+        report = job.terminate()
+        [stats] = report.statistics
+        assert stats.score > 0.8
+
+    def test_quorum_floor_is_respected(self):
+        """Liveness must never retire below the quorum floor: with
+        quorum=2 of 3 and TWO silent workers, only one retires."""
+        job, hub, now = _silent_worker_job()
+        for w in (1, 2):
+            job.spokes[w].nets[0].node.send = lambda *a, **k: None
+        lines = stream_lines(400, seed=4)
+        for l in lines[:200]:
+            job.process_event(TRAINING_STREAM, l)
+        now[0] = 2.0
+        for l in lines[200:]:
+            job.process_event(TRAINING_STREAM, l)
+        assert len(hub._retired_live) == 1
+        assert hub.round_target() == 2
+
+    def test_default_n_of_n_never_retires(self):
+        """comm.quorum unset => the exact pre-liveness behavior: the hub
+        waits for everyone, timeout or not."""
+        job = StreamJob(
+            JobConfig(parallelism=3, batch_size=16, test_set_size=16)
+        )
+        create = {
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "PA",
+                "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": 6},
+            },
+            "trainingConfiguration": {"protocol": "Synchronous", "syncEvery": 1},
+        }
+        job.process_event(REQUEST_STREAM, json.dumps(create))
+        hub = job.hub_manager.hubs[(0, 0)].node
+        assert not hub.liveness_armed
+        job.spokes[2].nets[0].node.send = lambda *a, **k: None
+        for l in stream_lines(300, seed=5):
+            job.process_event(TRAINING_STREAM, l)
+        assert hub._retired_live == set()
+        assert job.spokes[0].nets[0].node.waiting  # still blocked: n-of-n
+
+
+# --- satellite: SSP wait-set release when the last straggler retires ---------
+
+
+class TestSSPRetiredStraggler:
+    def _ssp_hub(self, n_workers=3, staleness=1):
+        from omldm_tpu.protocols.sync import SSPParameterServer
+
+        sent = []
+        tc = TrainingConfiguration(
+            protocol="SSP",
+            extra={"staleness": staleness,
+                   "comm": {"quorum": 2, "workerTimeoutMs": 1000}},
+        )
+        hub = SSPParameterServer(
+            0, 0, n_workers, 1, tc,
+            lambda w, op, p: sent.append((w, op, p)),
+            lambda op, p: sent.append(("*", op, p)),
+        )
+        return hub, sent
+
+    def _push(self, hub, worker, clock):
+        # mirror the runtime boundary (Hub.receive): every message marks
+        # the sender alive before protocol dispatch
+        hub.note_worker(worker)
+        hub.receive(worker, "push", {
+            "params": np.ones(4, np.float32) * clock,
+            "clock": clock, "curve": [], "fitted": 0,
+        })
+
+    def test_survivor_waiting_only_on_retired_straggler_releases(self):
+        """Satellite regression: workers 0 and 1 run ahead and block on
+        straggler 2's clock; liveness retires 2 mid-round — the release
+        loop must re-fire for the survivors even though the straggler was
+        the LAST member of their wait-set."""
+        hub, sent = self._ssp_hub()
+        now = [0.0]
+        hub._clock = lambda: now[0]
+        self._push(hub, 2, 1)   # straggler pushed once, then went silent
+        now[0] = 0.5
+        for clock in (1, 2, 3):
+            self._push(hub, 0, clock)
+            self._push(hub, 1, clock)
+        assert hub._waiting[0] and hub._waiting[1]
+
+        now[0] = 2.0  # straggler past the deadline
+        # blocked survivors stay visibly alive through their stall-watchdog
+        # NACKs (Hub.receive -> note_worker); emulate those heartbeats
+        hub.note_worker(0)
+        hub.note_worker(1)
+        hub.check_liveness()
+        assert hub._retired_live == {2}
+        assert 2 not in hub._clocks, "retired clock must leave the window"
+        assert not hub._waiting.get(0, False) and not hub._waiting.get(1, False)
+        released = [m for m in sent if m[1] == "update" and not m[2]["wait"]]
+        assert len(released) >= 2
+        assert hub.stats.quorum_releases >= 2
+
+    def test_shrink_rescale_release_still_works(self):
+        """The pre-existing rescale path: pruning retired ids on shrink
+        re-evaluates the wait-set the same way."""
+        hub, sent = self._ssp_hub()
+        self._push(hub, 2, 1)
+        for clock in (1, 2, 3):
+            self._push(hub, 0, clock)
+            self._push(hub, 1, clock)
+        assert hub._waiting[0] and hub._waiting[1]
+        hub.set_parallelism(2)
+        assert not hub._waiting.get(0, False) and not hub._waiting.get(1, False)
+
+    def test_never_pushed_straggler_releases_too(self):
+        """The straggler never pushed at all (clock-0 by absence): its
+        retirement must stop it from anchoring ``slowest`` at 0."""
+        hub, sent = self._ssp_hub()
+        now = [0.0]
+        hub._clock = lambda: now[0]
+        for clock in (1, 2, 3):
+            self._push(hub, 0, clock)
+            self._push(hub, 1, clock)
+        assert hub._waiting[0] and hub._waiting[1]
+        now[0] = 2.0
+        hub.note_worker(0)
+        hub.note_worker(1)
+        hub.check_liveness()
+        assert hub._retired_live == {2}
+        assert not hub._waiting.get(0, False) and not hub._waiting.get(1, False)
+
+
+# --- satellite: codec stream state for retired worker slots ------------------
+
+
+class TestCodecRetiredWorkerStreams:
+    def _seeded_codec(self):
+        codec = TransportCodec("topk", top_k=4)
+        for stream in ("w0>h0", "w2>h0", "h0>w0", "h0>w2", "h0>*"):
+            codec.encode({"params": np.arange(64, dtype=np.float32)}, stream)
+        # receive-side bases for both worker streams
+        for stream in ("w0>h0", "w2>h0"):
+            enc = codec.encode(
+                {"params": np.arange(64, dtype=np.float32)}, stream
+            )
+        codec._rx_base[("w0>h0", ".params")] = np.zeros(64, np.float32)
+        codec._rx_base[("w2>h0", ".params")] = np.ones(64, np.float32)
+        return codec
+
+    def test_reset_retired_clears_rx_and_tx_state(self):
+        """Satellite: after shrink-absorb, NO codec state — receive-side
+        delta bases included — may survive for retired worker node-ids: a
+        reused slot would decode against a dead worker's stale base."""
+        codec = self._seeded_codec()
+        codec.reset_retired_worker_streams(2)
+        for d in (codec._residual, codec._tx_base, codec._tx_seq,
+                  codec._rx_base):
+            for (stream, _path) in d:
+                assert "w2" not in stream, f"stale retired-worker stream {stream}"
+        # surviving workers' and broadcast streams stay intact
+        assert any(k[0] == "w0>h0" for k in codec._tx_base)
+        assert any(k[0] == "h0>*" for k in codec._tx_base)
+        assert any(k[0] == "w0>h0" for k in codec._rx_base)
+
+    def test_rescale_under_topk_converges(self):
+        """Pin the end-to-end path: topk codec + shrink + grow back into
+        the SAME worker slot. The hub's codec must hold no retired-slot
+        state after the shrink, and the regrown fleet must keep
+        converging (a stale base would wreck the decoded models)."""
+        cfg = JobConfig(parallelism=3, batch_size=16, test_set_size=16)
+        job = StreamJob(cfg)
+        create = {
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "PA",
+                "hyperParameters": {"C": 1.0},
+                # wide enough that the flat params clear the codec's
+                # min-leaf-size floor (tiny leaves ship raw)
+                "dataStructure": {"nFeatures": 32},
+            },
+            "trainingConfiguration": {
+                "protocol": "Asynchronous",
+                "syncEvery": 1,
+                "comm": {"codec": "topk", "anchorEvery": 8},
+            },
+        }
+        job.process_event(REQUEST_STREAM, json.dumps(create))
+        lines = stream_lines(1800, dim=32, seed=6)
+        for l in lines[:600]:
+            job.process_event(TRAINING_STREAM, l)
+        hub_codec = job.hub_manager.hubs[(0, 0)].node.codec
+        assert any("w2" in k[0] for k in hub_codec._rx_base), (
+            "precondition: worker 2 streams exist before the shrink"
+        )
+        job.rescale(2)
+        for d in (hub_codec._residual, hub_codec._tx_base,
+                  hub_codec._tx_seq, hub_codec._rx_base):
+            assert not any("w2" in k[0] for k in d), (
+                "retired worker 2's codec state must not survive the shrink"
+            )
+        for l in lines[600:1200]:
+            job.process_event(TRAINING_STREAM, l)
+        job.rescale(3)  # worker slot 2 is reused by a fresh join
+        for l in lines[1200:]:
+            job.process_event(TRAINING_STREAM, l)
+        report = job.terminate()
+        [stats] = report.statistics
+        assert stats.score > 0.8

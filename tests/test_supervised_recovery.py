@@ -284,6 +284,60 @@ def test_broker_severed_mid_stream_degrades(tmp_path):
 
 
 @pytest.mark.slow
+def test_supervised_kill_recovery_with_duplicating_broker(tmp_path):
+    """--supervise + chaos: the worker is killed mid-stream AND the broker
+    duplicates records (seeded ChaosConsumer, --kafkaChaos) — the
+    at-least-once misbehavior a real broker shows during replay after a
+    restart. Recovery must still converge: every unique row trains at
+    least once (duplicates can only ADD training passes, never lose rows),
+    the holdout score lands in the fault-free envelope, and nothing
+    crashes."""
+    sys.path.insert(0, TESTS)
+    import fskafka
+
+    broker = tmp_path / "broker"
+    os.environ["FSKAFKA_DIR"] = str(broker)
+    try:
+        for i, line in enumerate(_rows(600, seed=7)):
+            fskafka.append("trainingData", line, partition=i % 2)
+        fskafka.append("requests", _create())
+    finally:
+        os.environ.pop("FSKAFKA_DIR", None)
+
+    kafka = ["--kafkaBrokers", "fs://local", "--workerBoot", FSKAFKA_BOOT]
+    env = {"FSKAFKA_DIR": str(broker)}
+    clean, _ = _run(
+        kafka + ["--supervise", "true", "--processes", "1"],
+        "dupclean", tmp_path, env_extra=env,
+    )
+    sc = _stat(clean)
+
+    recovered, err = _run(
+        kafka + [
+            "--supervise", "true", "--processes", "1",
+            "--checkpointDir", str(tmp_path / "dupckpts"),
+            "--checkpointEvery", "1",
+            "--failProcess", "0", "--failAfterRecords", "400",
+            "--restartAttempts", "2", "--restartDelayMs", "50",
+            "--kafkaChaos", "seed=9,dup=0.1",
+        ],
+        "dupsup", tmp_path, env_extra=env,
+    )
+    assert "kafka consumer chaos armed" in err
+    assert "injected crash" in err
+    assert "relaunching fleet from latest consistent checkpoint" in err
+    sr = _stat(recovered)
+    # at-least-once: duplicates only add training passes — rows conserve
+    assert sr["fitted"] + recovered["holdout"]["0"] >= 600
+    # and the model still converges. (The duplicated records change which
+    # rows land in the 32-point holdout window, so the two scores are
+    # measured on different samples — an absolute convergence floor is the
+    # meaningful envelope here, not a tight delta.)
+    assert sc["score"] > 0.8
+    assert sr["score"] > 0.8
+
+
+@pytest.mark.slow
 def test_supervised_kill_chosen_worker_two_processes(tmp_path):
     """The acceptance scenario at full cluster shape: TWO real worker
     processes over gloo collectives, the injector kills worker 1 only,
