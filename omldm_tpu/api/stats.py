@@ -50,6 +50,12 @@ class Statistics:
     duplicates_dropped: int = 0
     gaps_resynced: int = 0
     quorum_releases: int = 0
+    # jitted XLA program launches dispatched on this pipeline's behalf on
+    # the host plane (fit / fit_many / predict / evaluate, plus ONE count
+    # per shared cohort gang launch, attributed to the triggering member
+    # so the cross-pipeline SUM equals real program launches); counted
+    # spoke-side and folded in at query/terminate time
+    program_launches: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -65,6 +71,7 @@ class Statistics:
         duplicates_dropped: int = 0,
         gaps_resynced: int = 0,
         quorum_releases: int = 0,
+        program_launches: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127)."""
         self.models_shipped += models_shipped
@@ -74,6 +81,7 @@ class Statistics:
         self.duplicates_dropped += duplicates_dropped
         self.gaps_resynced += gaps_resynced
         self.quorum_releases += quorum_releases
+        self.program_launches += program_launches
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -117,6 +125,7 @@ class Statistics:
             duplicates_dropped=self.duplicates_dropped + other.duplicates_dropped,
             gaps_resynced=self.gaps_resynced + other.gaps_resynced,
             quorum_releases=self.quorum_releases + other.quorum_releases,
+            program_launches=self.program_launches + other.program_launches,
             fitted=self.fitted + other.fitted,
             mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
             score=self.score + other.score,
@@ -140,6 +149,7 @@ class Statistics:
             "duplicatesDropped": self.duplicates_dropped,
             "gapsResynced": self.gaps_resynced,
             "quorumReleases": self.quorum_releases,
+            "programLaunches": self.program_launches,
             "numOfBlocks": self.num_of_blocks,
             "fitted": self.fitted,
             "learningCurve": self.learning_curve,

@@ -75,6 +75,26 @@ class JobConfig:
     # receive windows, NACK/resync) arms itself per pipeline.
     chaos: str = ""
 
+    # --- multi-tenant cohort execution (runtime.cohort; no reference
+    # counterpart: the reference steps every pipeline's PipelineMap entry
+    # serially per record, FlinkSpoke.scala:92-107) ---
+    # "off": every pipeline dispatches its own XLA programs (the exact
+    # pre-cohort code path). "auto" (default): same-spec pipelines gang
+    # into one stacked launch once `cohort_min` of them are live on a
+    # spoke. "on": every eligible pipeline cohorts immediately.
+    cohort: str = "auto"
+    # homogeneous-pipeline count above which "auto" forms a cohort.
+    cohort_min: int = 8
+    # gang member iteration: "map" (lax.map — bit-identical to
+    # per-pipeline execution, the CPU default), "vmap" (batched — faster
+    # on parallel backends, ~1e-9 batched-reduction drift), or "auto"
+    # (map on CPU, vmap elsewhere).
+    cohort_impl: str = "auto"
+    # Hub liveness walk stride on the record path: with quorum/timeout
+    # armed, the per-record check_liveness walk runs every N events (or on
+    # a deadline), not per record (runtime/hub.py).
+    liveness_stride: int = 16
+
     # --- TPU-native knobs (no reference counterpart) ---
     # Micro-batch size per training step; records are padded + masked to this
     # fixed shape so the jitted step never recompiles.

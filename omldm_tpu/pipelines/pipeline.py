@@ -14,7 +14,9 @@ incremental slices (FlinkHub.scala:101-116): each fit appends one lazy
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+import collections
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.flatten_util
@@ -38,12 +40,44 @@ def _freeze(obj):
     return obj
 
 
-# (learner spec, prep chain, dim, per_record) -> shared jitted callables.
-# Bounded in practice by the number of DISTINCT pipeline specs a job ever
-# deploys; entries capture ONLY stateless learner/preprocessor modules
-# (hyper-parameter holders), never a pipeline or its device-resident state
-# — a cached entry must not pin a deleted pipeline's weights.
-_JIT_CACHE: dict = {}
+class _LRUCache:
+    """Small LRU for jitted program sets: a long Create/Delete churn with
+    varying dims must not grow the process's executable set without bound.
+    Evicting is safe — a re-used spec simply re-traces on its next Create
+    (entries capture ONLY stateless learner/preprocessor modules, never a
+    pipeline or its device-resident state, so nothing else pins them)."""
+
+    def __init__(self, cap: int):
+        self.cap = max(int(cap), 1)
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+
+    def get(self, key):
+        value = self._entries.get(key)
+        if value is not None:
+            self._entries.move_to_end(key)
+        return value
+
+    def put(self, key, value) -> None:
+        self._entries[key] = value
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.cap:
+            self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+# (learner spec, prep chain, dim, per_record) -> shared jitted callables,
+# bounded by an LRU (distinct LIVE specs stay well under the cap; only
+# pathological churn over many dims ever evicts).
+_LRU_CAP = int(os.environ.get("OMLDM_JIT_CACHE_CAP", "64"))
+_JIT_CACHE: _LRUCache = _LRUCache(_LRU_CAP)
 
 
 def _build_impls(learner, preps, per_record):
@@ -121,6 +155,16 @@ class MLPipeline:
             )
         self.dim = dim
         self.per_record = per_record
+        # cohort co-hosting (runtime.cohort): when attached, `_cohort` owns
+        # the authoritative state (stacked with its same-spec siblings) and
+        # fit/predict/flat-params route through gang launches; `_state` is
+        # authoritative only while detached (the default).
+        self._cohort = None
+        self._slot = -1
+        # observability hook: called once per jitted program launch this
+        # pipeline dispatches (or triggers, for shared cohort launches) —
+        # feeds the Statistics `programLaunches` counter
+        self.on_launch: Optional[Callable[[], None]] = None
         # feature dim after each preprocessor
         d = dim
         self._dims = [d]
@@ -129,7 +173,7 @@ class MLPipeline:
             self._dims.append(d)
         self.learner_dim = d
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        self.state = {
+        self._state = {
             "preps": [p.init(di) for p, di in zip(self.preps, self._dims)],
             "params": self.learner.init(d, rng),
             "fitted": jnp.zeros((), jnp.int32),
@@ -142,6 +186,7 @@ class MLPipeline:
         self._curve_emitted = 0
         self._fitted_host = 0
 
+        self.cache_key = None
         if self.learner.host_side:
             # host-side learners (HT) run the SAME impls, un-jitted
             fit_i, pred_i, eval_i, _ = _build_impls(
@@ -169,6 +214,7 @@ class MLPipeline:
                 dim,
                 per_record,
             )
+            self.cache_key = key
             cached = _JIT_CACHE.get(key)
             if cached is None:
                 fit_i, pred_i, eval_i, many_i = _build_impls(
@@ -180,18 +226,45 @@ class MLPipeline:
                     jax.jit(eval_i),
                     jax.jit(many_i, donate_argnums=0),
                 )
-                _JIT_CACHE[key] = cached
+                _JIT_CACHE.put(key, cached)
             self._fit, self._predict, self._evaluate, self._fit_many = cached
 
     # --- public API ---
+
+    @property
+    def state(self):
+        """The pipeline state pytree. Detached: the local tree. Attached to
+        a cohort: the member's checked-out view — the SAME dict until the
+        next gang launch scatters it back, so in-place mutation
+        (checkpoint restore, merge_from) lands in the stacked tree."""
+        if self._cohort is not None:
+            return self._cohort.checkout(self._slot)
+        return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        if self._cohort is not None:
+            self._cohort.set_member_state(self._slot, value)
+        else:
+            self._state = value
+
+    def _count_launch(self) -> None:
+        if self.on_launch is not None:
+            self.on_launch()
 
     def fit(self, x, y, mask) -> Any:
         """Train on one micro-batch; returns the (lazy) mean loss.
 
         ``mask`` should be host-originated (numpy or host-built) — its valid
-        count feeds the host-side fitted counter without a device sync."""
+        count feeds the host-side fitted counter without a device sync.
+        Cohort-attached pipelines STAGE the batch for the cohort's next
+        gang launch and return an equally lazy loss."""
         n = int(np.asarray(mask).sum())
-        self.state, loss = self._fit(self.state, x, y, mask)
+        if self._cohort is not None:
+            loss = self._cohort.stage_fit(self._slot, x, y, mask)
+        else:
+            self._count_launch()
+            self._state, loss = self._fit(self._state, x, y, mask)
         self._fitted_host += n
         self._curve.append((loss, self._fitted_host))
         return loss
@@ -209,7 +282,11 @@ class MLPipeline:
             masks_np = np.asarray(masks)
             losses = [self.fit(x, y, m) for x, y, m in zip(xs, ys, masks_np)]
             return jnp.stack([jnp.asarray(l) for l in losses])
-        self.state, losses = self._fit_many(self.state, xs, ys, masks)
+        if self._cohort is not None:
+            losses = self._cohort.stage_fit_many(self._slot, xs, ys, masks)
+        else:
+            self._count_launch()
+            self._state, losses = self._fit_many(self._state, xs, ys, masks)
         # one curve entry holding the whole lazy [T] loss array — slicing
         # per batch here would dispatch T tiny device ops on the hot path;
         # curve_slice() unpacks it at stats-poll time instead
@@ -221,12 +298,47 @@ class MLPipeline:
         return losses
 
     def predict(self, x) -> jnp.ndarray:
-        return self._predict(self.state, x)
+        if self._cohort is not None:
+            # settle staged fits, then run the per-pipeline program on the
+            # member's state view (gang serving batches predictions at the
+            # spoke layer via Cohort.predict_rows instead)
+            st = self._cohort.peek_state(self._slot)
+            self._count_launch()
+            return self._predict(st, x)
+        self._count_launch()
+        return self._predict(self._state, x)
 
     def evaluate(self, x, y, mask) -> Tuple[float, float]:
         """(mean loss, score) on a held-out set, without updating."""
-        loss, score = self._evaluate(self.state, x, y, mask)
+        st = (
+            self._cohort.peek_state(self._slot)
+            if self._cohort is not None
+            else self._state
+        )
+        self._count_launch()
+        loss, score = self._evaluate(st, x, y, mask)
         return float(loss), float(score)
+
+    def settle_deferred(self) -> None:
+        """Run any deferred post-launch protocol action for this member NOW
+        (forces the pending gang launch). Blocking protocol workers call
+        this before their ``waiting`` check, so a deferred sync point that
+        sets ``waiting`` is visible exactly where the undeferred path would
+        have set it — the next batch then blocks instead of training on
+        pre-release params."""
+        if self._cohort is not None and self._cohort.has_deferred(self._slot):
+            self._cohort.launch()
+
+    def defer_after_launch(self, cb: Callable[[], None]) -> bool:
+        """Cohort hook for protocol sync points: when this pipeline has a
+        staged gang fit pending, run ``cb`` right after the gang launch
+        (instead of now, which would force a degenerate solo launch).
+        Returns False — act immediately — when detached or nothing is
+        staged."""
+        if self._cohort is not None and self._cohort.has_staged(self._slot):
+            self._cohort.after_launch(self._slot, cb)
+            return True
+        return False
 
     @property
     def fitted(self) -> int:
@@ -234,7 +346,9 @@ class MLPipeline:
 
     @property
     def cumulative_loss(self) -> float:
-        return float(self.state["cum_loss"])
+        if self._cohort is not None:
+            return self._cohort.member_cum_loss(self._slot)
+        return float(self._state["cum_loss"])
 
     def curve_slice(self) -> List[Tuple[float, int]]:
         """Drain the learning-curve points accumulated since the last call —
@@ -256,14 +370,20 @@ class MLPipeline:
 
     def get_flat_params(self) -> Tuple[np.ndarray, Any]:
         """Flatten learner params to one vector (for bucketed query responses
-        and protocol messaging); returns (flat, unravel_fn)."""
-        flat, unravel = jax.flatten_util.ravel_pytree(self.state["params"])
+        and protocol messaging); returns (flat, unravel_fn). Cohort members
+        read their row of the cohort's one-launch flat matrix."""
+        if self._cohort is not None:
+            return self._cohort.member_flat(self._slot)
+        flat, unravel = jax.flatten_util.ravel_pytree(self._state["params"])
         # writable copy: protocol code mutates shards in place
         return np.array(flat), unravel
 
     def set_flat_params(self, flat: np.ndarray) -> None:
-        _, unravel = jax.flatten_util.ravel_pytree(self.state["params"])
-        self.state["params"] = unravel(jnp.asarray(flat))
+        if self._cohort is not None:
+            self._cohort.set_member_flat(self._slot, flat)
+            return
+        _, unravel = jax.flatten_util.ravel_pytree(self._state["params"])
+        self._state["params"] = unravel(jnp.asarray(flat))
 
     def merge_from(self, others: Sequence["MLPipeline"]) -> None:
         """Merge parallel pipeline copies (rescale/restore), mirroring the

@@ -46,6 +46,13 @@ BroadcastFn = Callable[[str, Any], None]
 class WorkerNode:
     """Spoke-side protocol node wrapping a local pipeline replica."""
 
+    #: True when (non-waiting) ``on_training_batch`` consumes the batch
+    #: before returning — fits it into the local pipeline rather than
+    #: shipping or holding the arrays. Lets the runtime hand in zero-copy
+    #: batcher views on the cohort staging path. ForwardingWorker (raw
+    #: forwarding) and custom protocols keep the copying default.
+    consumes_batch_synchronously = False
+
     def __init__(
         self,
         pipeline: MLPipeline,
@@ -198,6 +205,12 @@ class HubNode:
         self.codec = make_transport_codec(config)
         self.reply = self._reply_ship
         self.broadcast = self._broadcast_ship
+        # cohort gang averaging (runtime.cohort.GangAverager): set by the
+        # HubManager when cohort execution is enabled; protocols with round
+        # averaging (SynchronousParameterServer) stage completed rounds on
+        # it so same-cohort shards average in one stacked reduction. None
+        # (the default) = every round averages inline, the pre-cohort path.
+        self.gang = None
         # --- hub-side worker liveness (comm.quorum / comm.workerTimeoutMs) ---
         # With a quorum configured, a worker silent beyond the timeout is
         # RETIRED from round accounting (the hub-side half of the

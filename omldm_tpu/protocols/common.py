@@ -33,6 +33,12 @@ def shard_slice(h: int, size: int, n_hubs: int) -> slice:
 
 
 class SyncingWorker(WorkerNode):
+    # a non-waiting batch fits into the local replica before returning;
+    # the runtime only hands in zero-copy views when NOT waiting (the
+    # waiting branch holds its batches in _blocked, so those must own
+    # their arrays — the batcher's copying flush covers that case)
+    consumes_batch_synchronously = True
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.sync_every = int(self.config.extra.get("syncEvery", 4))
@@ -100,6 +106,11 @@ class SyncingWorker(WorkerNode):
     # --- training path with blocking support ---
 
     def on_training_batch(self, x, y, mask) -> Optional[float]:
+        # a sync point deferred past the last gang launch may set
+        # `waiting`: run it before the check, so this batch blocks where
+        # the undeferred path would have blocked it (no-op when detached
+        # or nothing is deferred)
+        self.pipeline.settle_deferred()
         if self.waiting:
             if len(self._blocked) < MAX_BLOCKED_BATCHES:
                 self._blocked.append((x, y, mask))
@@ -113,7 +124,11 @@ class SyncingWorker(WorkerNode):
         loss = self.pipeline.fit(x, y, mask)
         self._batches += 1
         if self._batches % self.sync_every == 0:
-            self.on_sync_point()
+            # cohort gang dispatch: when the fit was STAGED, the sync point
+            # (which reads the post-fit model) runs right after the shared
+            # gang launch instead of forcing a degenerate solo launch now
+            if not self.pipeline.defer_after_launch(self.on_sync_point):
+                self.on_sync_point()
         return loss
 
     def drain_blocked(self) -> None:

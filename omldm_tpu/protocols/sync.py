@@ -86,15 +86,24 @@ class SynchronousParameterServer(HubNode):
         # whole fleet blocking on a dead straggler forever
         if len(self._round) >= self.round_target():
             stacked = np.stack(list(self._round.values()))
-            self.global_params = stacked.mean(axis=0)
             self._round.clear()
-            self.note_round_release()
-            self.count_shipped(
-                self.global_params,
-                n_dest=self.n_workers,
-                models=self.n_workers if self.hub_id == 0 else 0,
-            )
-            self.broadcast(OP_UPDATE, self.global_params)
+            if self.gang is not None and self.gang.active:
+                # cohort gang averaging: same-cohort shards whose rounds
+                # complete in this event window average together in one
+                # stacked reduction, then broadcast from _finish_round
+                self.gang.stage(self, stacked)
+            else:
+                self._finish_round(stacked.mean(axis=0))
+
+    def _finish_round(self, averaged: np.ndarray) -> None:
+        self.global_params = averaged
+        self.note_round_release()
+        self.count_shipped(
+            self.global_params,
+            n_dest=self.n_workers,
+            models=self.n_workers if self.hub_id == 0 else 0,
+        )
+        self.broadcast(OP_UPDATE, self.global_params)
 
     def worker_retired(self, worker_id: int) -> None:
         # its in-flight contribution (if any) still averages into the

@@ -1,0 +1,773 @@
+"""Cohort execution engine: multi-pipeline co-hosting with gang dispatch.
+
+The host plane hosts one ``MLPipeline`` per (spoke, networkId). PRs 2-5 made
+the *single*-pipeline path fast, but with M live pipelines the spoke still
+pays M separate tiny XLA program launches per micro-batch cycle:
+``_JIT_CACHE`` (pipelines/pipeline.py) shares *compilation* across same-spec
+pipelines while *dispatch* stays per-pipeline, so multi-tenant throughput
+collapses roughly linearly with pipeline count.
+
+This module groups live pipelines into **cohorts** keyed by the same
+``_JIT_CACHE`` key (learner spec, prep chain, dim, per_record), stacks their
+state pytrees along a leading pipeline axis, and runs fit / predict /
+flat-params for the whole cohort as ONE jitted, donated program launch:
+
+- **Staged gang fit** — ``MLPipeline.fit`` on an attached pipeline *stages*
+  its micro-batch instead of dispatching; the spoke's gang barrier (end of a
+  record / packed block) launches every staged batch of the cohort as one
+  program over ``[capacity, T, B, ...]`` inputs. Capacity and the staging
+  depth T are bucketed to powers of two so Create/Update/Delete/rescale
+  churn compacts slots (free-list reuse) instead of recompiling; inactive
+  slots ride along with zero masks and bit-identically keep their state.
+- **Gang member iteration** — the per-member program is ``lax.scan`` of the
+  SAME ``fit_impl`` the per-pipeline path jits, iterated over members with
+  ``lax.map`` (default on CPU): one launch, and the math per member is
+  bit-identical to per-pipeline execution (pinned by tests/test_cohort.py).
+  ``cohort_impl="vmap"`` swaps in ``jax.vmap`` — faster on batch-parallel
+  backends but subject to batched-reduction rounding (~1e-9 relative), so it
+  is only the default off-CPU.
+- **Gang flat params** — protocol sync points read/write flat parameter
+  vectors (``get_flat_params``/``set_flat_params``). A cohort computes the
+  whole ``[capacity, P]`` flat matrix in one launch (cached, row-invalidated
+  on writes) and scatters written rows back in one batched unravel+scatter,
+  so M same-spec sync points cost O(1) launches instead of O(M) ravels.
+- **Deferred protocol actions** — ``WorkerNode`` sync points that would
+  force a mid-gang launch (get_flat after the round's fit) register through
+  ``MLPipeline.defer_after_launch`` and run right after the gang launch, so
+  a sync round stays ONE launch for the whole cohort.
+- **Gang hub averaging** — :class:`GangAverager` lets same-protocol cohort
+  members' parameter-server shards stage their completed round matrices and
+  average them in one stacked ``[M, W, P]`` numpy reduction at the job's
+  event barrier (wired to ``SynchronousParameterServer``).
+
+The engine is armed by ``JobConfig.cohort``: ``"off"`` (every route is the
+exact pre-cohort code path), ``"auto"`` (cohorts form once
+``cohort_min`` homogeneous pipelines are live on a spoke — the default), or
+``"on"`` (every eligible pipeline cohorts immediately, capacity 1 up).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from omldm_tpu.pipelines.pipeline import _LRU_CAP, _LRUCache, _build_impls
+
+# staged batches per member before a launch is forced: bounds the gang input
+# tensor [capacity, T, B, D] when a pipeline has no sync point for a while
+MAX_STAGE_DEPTH = 32
+
+# gang program cache: (pipeline cache key, use_vmap) -> jitted callables.
+# Shape specialization inside jit handles the (capacity, T) buckets; this
+# cache only bounds the number of traced python callables, like _JIT_CACHE.
+_GANG_CACHE: _LRUCache = _LRUCache(_LRU_CAP)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _build_gang_programs(learner, preps, per_record: bool, use_vmap: bool):
+    """The (fit, shared-input fit, predict, flat) jitted programs for a
+    cohort spec.
+
+    The member computation is the SAME ``fit_impl`` the per-pipeline path
+    jits; only the iteration over members differs (lax.map or vmap)."""
+    fit_impl, predict_impl, _eval_impl, _ = _build_impls(
+        learner, preps, per_record
+    )
+
+    def member_fit(st, xs_m, ys_m, ms_m):
+        def step(st, batch):
+            x, y, m = batch
+            new_st, loss = fit_impl(st, x, y, m)
+            # zero-mask steps (T padding, inactive slots) keep their state
+            # BITWISE: the computed branch is discarded by the select, so
+            # even a NaN from an all-masked update cannot leak
+            keep = jnp.sum(m) > 0
+            new_st = _tree_map(
+                lambda a, b: jnp.where(keep, a, b), new_st, st
+            )
+            return new_st, loss
+
+        return jax.lax.scan(step, st, (xs_m, ys_m, ms_m))
+
+    def _ravel(p):
+        return jax.flatten_util.ravel_pytree(p)[0]
+
+    if use_vmap:
+        gang_fit = jax.vmap(member_fit)
+        gang_predict = jax.vmap(predict_impl)
+        gang_flat = jax.vmap(_ravel)
+    else:
+        def gang_fit(state, xs, ys, ms):
+            return jax.lax.map(
+                lambda z: member_fit(*z), (state, xs, ys, ms)
+            )
+
+        def gang_predict(state, xs):
+            return jax.lax.map(lambda z: predict_impl(*z), (state, xs))
+
+        def gang_flat(params):
+            return jax.lax.map(_ravel, params)
+
+    def gang_fit_shared(state, active, xs, ys, ms):
+        # SHARED-input twin: every member trains the same [T, B, ...]
+        # batches, shipped ONCE and broadcast in-program (XLA folds the
+        # broadcast into the per-member slices, so the host->device
+        # conversion stops scaling with the member count). The member
+        # computation is gang_fit's own — inactive slots just see zero
+        # masks, the same bitwise state-preserving select as T padding.
+        cap = jax.tree_util.tree_leaves(state)[0].shape[0]
+        xs_b = jnp.broadcast_to(xs, (cap,) + xs.shape)
+        ys_b = jnp.broadcast_to(ys, (cap,) + ys.shape)
+        act = active.reshape((cap,) + (1,) * ms.ndim)
+        ms_b = jnp.where(
+            act, jnp.broadcast_to(ms, (cap,) + ms.shape), 0.0
+        )
+        return gang_fit(state, xs_b, ys_b, ms_b)
+
+    return (
+        jax.jit(gang_fit, donate_argnums=0),
+        jax.jit(gang_fit_shared, donate_argnums=0),
+        jax.jit(gang_predict),
+        jax.jit(gang_flat),
+    )
+
+
+class _LaunchResult:
+    """Shared holder for one gang launch's ``[C, T]`` loss matrix. Created
+    when staging opens a launch group, fulfilled (lazily) at launch, and
+    materialized to numpy at most once — forcing the launch first if a
+    learning-curve poll somehow reads it early."""
+
+    __slots__ = ("_cohort", "_lazy", "_np")
+
+    def __init__(self, cohort: "Cohort"):
+        self._cohort: Optional[Cohort] = cohort
+        self._lazy = None
+        self._np: Optional[np.ndarray] = None
+
+    def fulfill(self, losses) -> None:
+        self._lazy = losses
+        self._cohort = None
+
+    def values(self) -> np.ndarray:
+        if self._np is None:
+            if self._lazy is None:
+                cohort, self._cohort = self._cohort, None
+                if cohort is not None:
+                    cohort.launch()
+            self._np = np.asarray(self._lazy)
+            self._lazy = None
+        return self._np
+
+
+class _StagedLoss:
+    """Lazy loss of a staged fit: floats (or arrays, for fit_many chains)
+    exactly like the lazy device scalars the un-cohorted path returns."""
+
+    __slots__ = ("_res", "_slot", "_t0", "_t1")
+
+    def __init__(self, res: _LaunchResult, slot: int, t0: int,
+                 t1: Optional[int] = None):
+        self._res = res
+        self._slot = slot
+        self._t0 = t0
+        self._t1 = t1
+
+    def _resolve(self):
+        vals = self._res.values()
+        if self._t1 is None:
+            return vals[self._slot, self._t0]
+        return vals[self._slot, self._t0:self._t1]
+
+    def __float__(self) -> float:
+        return float(self._resolve())
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._resolve(), dtype)
+
+
+class Cohort:
+    """Same-spec pipelines sharing one stacked state tree + gang programs.
+
+    Slots: ``members[slot]`` is the attached pipeline or None; capacity is
+    a power of two; churn reuses freed slots (compaction) and only a full
+    cohort doubles capacity (a shape change XLA re-specializes once)."""
+
+    def __init__(self, pipeline, use_vmap: bool, timer=None):
+        self.key = pipeline.cache_key
+        self.use_vmap = use_vmap
+        self.timer = timer
+        programs = _GANG_CACHE.get((self.key, use_vmap))
+        if programs is None:
+            programs = _build_gang_programs(
+                pipeline.learner, pipeline.preps, pipeline.per_record,
+                use_vmap,
+            )
+            _GANG_CACHE.put((self.key, use_vmap), programs)
+        self._gfit, self._gfit_shared, self._gpred, self._gflat = programs
+        flat0, self._unravel = jax.flatten_util.ravel_pytree(
+            pipeline._state["params"]
+        )
+        self._flat_size = int(flat0.size)
+        self._junflat = jax.jit(
+            lambda mat: jax.lax.map(self._unravel, mat)
+        )
+        self.capacity = 0
+        self.members: List[Optional[Any]] = []
+        self.n_active = 0
+        self._free: List[int] = []
+        self.stacked = None
+        # host-side authoritative overrides, scattered before every launch
+        self._host_state: Dict[int, dict] = {}
+        self._pending_flat: Dict[int, np.ndarray] = {}
+        # staging: persistent [capacity, T, B, ...] numpy buffers written
+        # in place at stage time (no per-launch allocation or entry
+        # lists); `_counts` tracks the staged depth per slot, and only
+        # the staged mask region is re-zeroed after a launch — stale
+        # x/y garbage under a zero mask is discarded bitwise in-program
+        self._counts: Dict[int, int] = {}
+        self._buf_x: Optional[np.ndarray] = None
+        self._buf_y: Optional[np.ndarray] = None
+        self._buf_m: Optional[np.ndarray] = None
+        # shared-input detection: when every member's staged batch at each
+        # depth is the SAME array object (the spoke's shared-ingest path
+        # flushes one batcher to all members of an identical-stream
+        # cohort), the launch runs the shared program over ONE [T, B, ...]
+        # input instead of a [capacity, T, B, ...] stack — collapsing the
+        # dominant host->device conversion by the member count
+        self._share_first: Optional[int] = None
+        self._share_rows: List[Tuple[Any, Any, Any]] = []
+        self._all_shared = False
+        self._next_result: Optional[_LaunchResult] = None
+        # deferred protocol actions (sync points) run right after a launch
+        self._post: List[Tuple[int, Callable[[], None]]] = []
+        self._post_slots: set = set()
+        self._flat_cache: Optional[np.ndarray] = None
+        self._in_launch = False
+        self.attach(pipeline)
+
+    # --- membership ------------------------------------------------------
+
+    def attach(self, pipeline) -> int:
+        """Adopt a pipeline: its local state seeds a (reused or new) slot
+        and the pipeline's hot-path methods route through the cohort."""
+        self.launch()
+        if self.stacked is None:
+            # first member: capacity-1 stack seeded from its state
+            self.capacity = 1
+            self.members = [pipeline]
+            self.n_active = 1
+            self.stacked = _tree_map(
+                lambda l: jnp.asarray(l)[None], pipeline._state
+            )
+            pipeline._cohort = self
+            pipeline._slot = 0
+            pipeline._state = None
+            self._flat_cache = None
+            return 0
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        state = pipeline._state
+        self.stacked = _tree_map(
+            lambda leaf, v: leaf.at[slot].set(jnp.asarray(v)),
+            self.stacked, state,
+        )
+        self.members[slot] = pipeline
+        self.n_active += 1
+        pipeline._cohort = self
+        pipeline._slot = slot
+        pipeline._state = None
+        self._flat_cache = None
+        return slot
+
+    def detach(self, pipeline) -> None:
+        """Release a member: its slot's state materializes back into the
+        pipeline and the slot returns to the free list for churn reuse."""
+        self.launch()
+        slot = pipeline._slot
+        pipeline._state = _tree_map(lambda l: l[slot], self.stacked)
+        pipeline._cohort = None
+        pipeline._slot = -1
+        self.members[slot] = None
+        self.n_active -= 1
+        self._host_state.pop(slot, None)
+        self._pending_flat.pop(slot, None)
+        self._free.append(slot)
+        self._free.sort(reverse=True)  # reuse the lowest slot first
+
+    def _grow(self) -> None:
+        """Double capacity (power-of-two buckets): the new region is filled
+        with duplicated rows — inert until a slot is seeded by attach."""
+        old = self.capacity
+        self.stacked = _tree_map(
+            lambda l: jnp.concatenate([l, l], axis=0), self.stacked
+        )
+        self.members.extend([None] * old)
+        self._free.extend(range(old * 2 - 1, old - 1, -1))
+        self._free.sort(reverse=True)
+        self.capacity = old * 2
+
+    # --- staging ----------------------------------------------------------
+
+    def has_staged(self, slot: int) -> bool:
+        return slot in self._counts
+
+    def has_deferred(self, slot: int) -> bool:
+        return slot in self._post_slots
+
+    def after_launch(self, slot: int, cb: Callable[[], None]) -> None:
+        self._post.append((slot, cb))
+        self._post_slots.add(slot)
+
+    def _open_group(self) -> _LaunchResult:
+        if self._next_result is None:
+            self._next_result = _LaunchResult(self)
+        return self._next_result
+
+    def _stage_room(self, slot: int, x: np.ndarray, y: np.ndarray,
+                    m: np.ndarray, need: int) -> int:
+        """Make room for ``need`` more staged steps on ``slot``; returns
+        the slot's current depth (post any forced launch/realloc)."""
+        if slot in self._post_slots:
+            # a deferred sync point is pending for this member: it must run
+            # (on the post-launch model) before the member's next fit
+            self.launch()
+        n = self._counts.get(slot, 0)
+        if n + need > MAX_STAGE_DEPTH:
+            self.launch()
+            n = 0
+        buf = self._buf_x
+        if (
+            buf is None
+            or buf.shape[0] != self.capacity
+            or buf.shape[2:] != x.shape
+            or buf.shape[1] < n + need
+        ):
+            self._realloc_buffers(x, y, m, n + need)
+            n = self._counts.get(slot, 0)  # a shape-mismatch realloc launches
+        return n
+
+    def _realloc_buffers(self, x, y, m, depth: int) -> None:
+        t_alloc = _pow2(max(depth, 4))
+        new_x = np.zeros((self.capacity, t_alloc) + x.shape, np.float32)
+        new_y = np.zeros((self.capacity, t_alloc) + y.shape, np.float32)
+        new_m = np.zeros((self.capacity, t_alloc) + m.shape, np.float32)
+        if self._counts and self._buf_x is not None:
+            if self._buf_x.shape[2:] != x.shape:
+                # same-cohort batches always share a shape; a mismatch can
+                # only arrive across a settle point
+                self.launch()
+                self._counts = {}
+            else:
+                c = min(self._buf_x.shape[0], self.capacity)
+                t = min(self._buf_x.shape[1], t_alloc)
+                new_x[:c, :t] = self._buf_x[:c, :t]
+                new_y[:c, :t] = self._buf_y[:c, :t]
+                new_m[:c, :t] = self._buf_m[:c, :t]
+        self._buf_x, self._buf_y, self._buf_m = new_x, new_y, new_m
+
+    def _materialize_shared(self) -> None:
+        """Backfill the per-slot buffers of members that skipped their
+        copies under shared detection; per-slot launching is valid after."""
+        if not self._all_shared:
+            return
+        self._all_shared = False
+        lead = self._share_first
+        for slot, n in self._counts.items():
+            if slot == lead:
+                continue
+            self._buf_x[slot, :n] = self._buf_x[lead, :n]
+            self._buf_y[slot, :n] = self._buf_y[lead, :n]
+            self._buf_m[slot, :n] = self._buf_m[lead, :n]
+        self._share_rows = []
+
+    def stage_fit(self, slot: int, x, y, mask) -> _StagedLoss:
+        x = np.asarray(x)
+        y = np.asarray(y)
+        m = np.asarray(mask)
+        n = self._stage_room(slot, x, y, m, 1)
+        res = self._open_group()
+        if not self._counts:
+            # first stage of a launch group: it leads shared detection
+            self._share_first = slot
+            self._share_rows = [(x, y, m)]
+            self._all_shared = True
+        elif self._all_shared:
+            if slot == self._share_first and n == len(self._share_rows):
+                self._share_rows.append((x, y, m))
+            elif (
+                slot != self._share_first
+                and n < len(self._share_rows)
+                and x is self._share_rows[n][0]
+                and y is self._share_rows[n][1]
+                and m is self._share_rows[n][2]
+            ):
+                # identical objects: the leader's buffer row IS this
+                # member's batch — no copy
+                self._counts[slot] = n + 1
+                return _StagedLoss(res, slot, n)
+            else:
+                self._materialize_shared()
+        self._buf_x[slot, n] = x
+        self._buf_y[slot, n] = y
+        self._buf_m[slot, n] = m
+        self._counts[slot] = n + 1
+        return _StagedLoss(res, slot, n)
+
+    def stage_fit_many(self, slot: int, xs, ys, masks) -> _StagedLoss:
+        xs = np.asarray(xs)
+        ys = np.asarray(ys)
+        ms = np.asarray(masks)
+        depth = int(xs.shape[0])
+        n = self._stage_room(slot, xs[0], ys[0], ms[0], depth)
+        self._materialize_shared()  # chained drains never share objects
+        res = self._open_group()
+        self._buf_x[slot, n : n + depth] = xs
+        self._buf_y[slot, n : n + depth] = ys
+        self._buf_m[slot, n : n + depth] = ms
+        self._counts[slot] = n + depth
+        return _StagedLoss(res, slot, n, n + depth)
+
+    # --- launching --------------------------------------------------------
+
+    def launch(self) -> None:
+        """Gang barrier: execute every staged fit, then run the deferred
+        protocol actions (which may stage/launch more — e.g. a sync push
+        whose round release drains blocked batches)."""
+        if self._in_launch:
+            self._run_staged()
+            return
+        self._in_launch = True
+        try:
+            while True:
+                self._run_staged()
+                if not self._post:
+                    break
+                post, self._post = self._post, []
+                self._post_slots = set()
+                for _slot, cb in post:
+                    cb()
+        finally:
+            self._in_launch = False
+
+    def _note_launch(self, slot: int) -> None:
+        member = self.members[slot] if 0 <= slot < self.capacity else None
+        if member is not None and member.on_launch is not None:
+            member.on_launch()
+
+    def _timed(self):
+        return self.timer if self.timer is not None else contextlib.nullcontext()
+
+    def _run_staged(self) -> None:
+        self._apply_host_writes()
+        if not self._counts:
+            return
+        shared = (
+            self._all_shared
+            and len(self._counts) > 1
+            and len(set(self._counts.values())) == 1
+        )
+        if not shared:
+            self._materialize_shared()
+        lead = self._share_first
+        self._share_first = None
+        self._share_rows = []
+        self._all_shared = False
+        counts, self._counts = self._counts, {}
+        result, self._next_result = self._next_result, None
+        t_pad = _pow2(max(counts.values()))
+        self._note_launch(min(counts))
+        if shared:
+            # one [T, B, ...] input for the whole cohort: the conversion
+            # cost stops scaling with the member count
+            xs = self._buf_x[lead, :t_pad]
+            ys = self._buf_y[lead, :t_pad]
+            ms = self._buf_m[lead, :t_pad]
+            active = np.zeros((self.capacity,), np.bool_)
+            active[list(counts)] = True
+            with self._timed():
+                self.stacked, losses = self._gfit_shared(
+                    self.stacked, active, xs, ys, ms
+                )
+            self._buf_m[lead, :t_pad] = 0.0
+        else:
+            xs = self._buf_x[:, :t_pad]
+            ys = self._buf_y[:, :t_pad]
+            ms = self._buf_m[:, :t_pad]
+            with self._timed():
+                # the dispatch copies host buffers to device arrays before
+                # it returns, so reusing the staging buffers after is safe
+                self.stacked, losses = self._gfit(self.stacked, xs, ys, ms)
+            # re-zero ONLY the staged mask region: everything else is
+            # already zero, and stale x/y rows under a zero mask are inert
+            for slot, n in counts.items():
+                self._buf_m[slot, :n] = 0.0
+        if result is not None:
+            result.fulfill(losses)
+        self._flat_cache = None
+
+    def _apply_host_writes(self) -> None:
+        """Scatter host-side authoritative state (checkouts, written flat
+        rows) back into the stacked tree before the next program runs."""
+        if self._host_state:
+            for slot, st in self._host_state.items():
+                self.stacked = _tree_map(
+                    lambda leaf, v: leaf.at[slot].set(jnp.asarray(v)),
+                    self.stacked, st,
+                )
+            self._host_state.clear()
+            self._flat_cache = None
+        if self._pending_flat:
+            slots = sorted(self._pending_flat)
+            k = _pow2(len(slots))
+            mat = np.zeros((k, self._flat_size), np.float32)
+            for i, s in enumerate(slots):
+                mat[i] = self._pending_flat[s]
+            # pad with duplicates of the first row/index: a duplicate
+            # scatter index writes the same value, so the pow2 bucket is
+            # free of shape churn without perturbing any other slot
+            mat[len(slots):] = mat[0]
+            idx = np.asarray(
+                slots + [slots[0]] * (k - len(slots)), np.int32
+            )
+            new_params = self._junflat(jnp.asarray(mat))
+            jidx = jnp.asarray(idx)
+            self.stacked["params"] = _tree_map(
+                lambda leaf, u: leaf.at[jidx].set(u),
+                self.stacked["params"], new_params,
+            )
+            self._pending_flat.clear()
+
+    # --- member state access ---------------------------------------------
+
+    def checkout(self, slot: int) -> dict:
+        """Authoritative (host-cached) state dict for one member. The SAME
+        dict is returned until the next launch scatters it back, so callers
+        that mutate entries in place (checkpoint restore, merge_from) see
+        their writes land in the stacked tree."""
+        st = self._host_state.get(slot)
+        if st is None:
+            self.launch()
+            st = _tree_map(lambda l: l[slot], self.stacked)
+            pend = self._pending_flat.pop(slot, None)
+            if pend is not None:
+                st["params"] = self._unravel(jnp.asarray(pend))
+            self._host_state[slot] = st
+            self._flat_cache = None  # caller may mutate params
+        return st
+
+    def set_member_state(self, slot: int, value: dict) -> None:
+        self.launch()
+        self._pending_flat.pop(slot, None)
+        self._host_state[slot] = value
+        self._flat_cache = None
+
+    def peek_state(self, slot: int) -> dict:
+        """Read-only member state snapshot (predict/evaluate)."""
+        st = self._host_state.get(slot)
+        if st is not None:
+            return st
+        self.launch()
+        return _tree_map(lambda l: l[slot], self.stacked)
+
+    def member_flat(self, slot: int):
+        """(flat params row copy, unravel) — the gang get_flat: the [C, P]
+        flat matrix is computed in ONE launch and cached; row writes keep
+        the cache warm instead of invalidating it."""
+        st = self._host_state.get(slot)
+        if st is not None:
+            flat, _ = jax.flatten_util.ravel_pytree(st["params"])
+            return np.array(flat), self._unravel
+        self.launch()
+        if self._flat_cache is None:
+            self._note_launch(slot)
+            with self._timed():
+                # writable copy: row writes keep the cache warm
+                self._flat_cache = np.array(
+                    self._gflat(self.stacked["params"])
+                )
+        return self._flat_cache[slot].copy(), self._unravel
+
+    def set_member_flat(self, slot: int, flat: np.ndarray) -> None:
+        if slot in self._host_state:
+            self._host_state[slot]["params"] = self._unravel(
+                jnp.asarray(flat)
+            )
+            return
+        row = np.array(flat, np.float32, copy=True)
+        self._pending_flat[slot] = row
+        if self._flat_cache is not None:
+            self._flat_cache[slot] = row
+
+    def member_cum_loss(self, slot: int) -> float:
+        st = self._host_state.get(slot)
+        if st is not None:
+            return float(st["cum_loss"])
+        self.launch()
+        return float(self.stacked["cum_loss"][slot])
+
+    def predict_rows(self, entries: List[Tuple[int, np.ndarray]]) -> np.ndarray:
+        """Gang forecast serving: one padded predict launch over the whole
+        cohort; ``entries`` are (slot, padded batch) pairs and the result
+        indexes ``[slot]`` per participant."""
+        self.launch()
+        x0 = entries[0][1]
+        xs = np.zeros((self.capacity,) + x0.shape, np.float32)
+        for slot, xb in entries:
+            xs[slot] = xb
+        self._note_launch(entries[0][0])
+        with self._timed():
+            out = self._gpred(self.stacked, xs)
+        return np.asarray(out)
+
+
+class CohortEngine:
+    """Per-spoke cohort manager: groups eligible pipelines by jit-cache key
+    and forms cohorts per the configured mode/threshold."""
+
+    def __init__(self, config, timer=None):
+        mode = str(getattr(config, "cohort", "off")).lower()
+        self.mode = mode if mode in ("auto", "on") else "off"
+        self.min_members = (
+            1 if self.mode == "on"
+            else max(int(getattr(config, "cohort_min", 8)), 1)
+        )
+        impl = str(getattr(config, "cohort_impl", "auto")).lower()
+        if impl == "auto":
+            self.use_vmap = jax.default_backend() != "cpu"
+        else:
+            self.use_vmap = impl == "vmap"
+        self.timer = timer
+        self.cohorts: Dict[Any, Cohort] = {}
+        self._pool: Dict[Any, List[Any]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    @staticmethod
+    def eligible(pipeline) -> bool:
+        """Dense, device-side pipelines with float32 flat params gang;
+        host-side (HT), SingleLearner-forced (the model lives on the hub,
+        spoke replicas only serve) and sparse-COO learners keep the
+        per-pipeline path."""
+        from omldm_tpu.learners.registry import SINGLE_LEARNER_ONLY
+
+        if pipeline.cache_key is None or pipeline.learner.host_side:
+            return False
+        if pipeline.learner.name in SINGLE_LEARNER_ONLY:
+            return False
+        if getattr(pipeline.learner, "sparse", False):
+            return False
+        if pipeline._cohort is not None:
+            return False
+        flat, _ = jax.flatten_util.ravel_pytree(pipeline._state["params"])
+        return flat.dtype == jnp.float32
+
+    def consider(self, pipeline) -> None:
+        """Offer a (new) pipeline: joins its key's cohort, or pools until
+        the auto threshold forms one."""
+        if self.mode == "off" or not self.eligible(pipeline):
+            return
+        key = pipeline.cache_key
+        cohort = self.cohorts.get(key)
+        if cohort is not None:
+            cohort.attach(pipeline)
+            return
+        pool = self._pool.setdefault(key, [])
+        pool.append(pipeline)
+        if len(pool) >= self.min_members:
+            cohort = Cohort(pool[0], self.use_vmap, timer=self.timer)
+            for p in pool[1:]:
+                cohort.attach(p)
+            self.cohorts[key] = cohort
+            del self._pool[key]
+
+    def retire(self, pipeline) -> None:
+        cohort = pipeline._cohort
+        if cohort is not None:
+            cohort.detach(pipeline)
+            if cohort.n_active == 0:
+                self.cohorts.pop(cohort.key, None)
+            return
+        pool = self._pool.get(getattr(pipeline, "cache_key", None))
+        if pool and pipeline in pool:
+            pool.remove(pipeline)
+
+    def flush(self) -> None:
+        """Gang barrier: launch every cohort's staged work."""
+        for cohort in self.cohorts.values():
+            cohort.launch()
+
+    def detach_all(self) -> None:
+        """Dissolve every cohort (rescale absorb, shutdown): members get
+        their state back and run per-pipeline until re-considered."""
+        for cohort in list(self.cohorts.values()):
+            for member in list(cohort.members):
+                if member is not None:
+                    cohort.detach(member)
+        self.cohorts.clear()
+        self._pool.clear()
+
+
+class GangAverager:
+    """Deferred, vectorized model averaging for same-protocol cohort
+    members' parameter-server shards.
+
+    A hub whose round completes inside an active window stages its stacked
+    ``[W, P]`` contribution matrix; at the window's exit every same-shape
+    group averages in ONE ``[M, W, P]`` numpy reduction (bit-identical to
+    the per-hub ``mean(axis=0)``) and the hubs broadcast their releases.
+    Outside a window ``active`` is False and hubs average immediately — the
+    exact pre-cohort behavior."""
+
+    def __init__(self):
+        self._depth = 0
+        self._staged: List[Tuple[Any, np.ndarray]] = []
+
+    @property
+    def active(self) -> bool:
+        return self._depth > 0
+
+    @contextlib.contextmanager
+    def window(self):
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            if self._depth == 0:
+                self.flush()
+
+    def stage(self, hub_node, stacked: np.ndarray) -> None:
+        self._staged.append((hub_node, stacked))
+
+    def flush(self) -> None:
+        # releases can complete further rounds synchronously (a released
+        # worker drains, pushes, and closes the next round): loop until dry
+        while self._staged:
+            staged, self._staged = self._staged, []
+            groups: Dict[Tuple[int, ...], List[Tuple[Any, np.ndarray]]] = {}
+            for node, mat in staged:
+                groups.setdefault(mat.shape, []).append((node, mat))
+            for items in groups.values():
+                if len(items) == 1:
+                    node, mat = items[0]
+                    node._finish_round(mat.mean(axis=0))
+                    continue
+                means = np.stack([m for _, m in items]).mean(axis=1)
+                for (node, _), avg in zip(items, means):
+                    node._finish_round(avg)

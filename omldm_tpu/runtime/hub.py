@@ -10,6 +10,7 @@ from the PS (FlinkHub.scala:88-157).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -84,6 +85,11 @@ class Hub:
                     rng=jax.random.PRNGKey(request.id),
                     per_record=tc.per_record,
                 )
+            )
+            # hub-side fits are host-plane program launches too
+            stats = self.node.stats
+            self.node.pipeline.on_launch = (
+                lambda: stats.update_stats(program_launches=1)
             )
 
     def receive(
@@ -183,6 +189,22 @@ class HubManager:
         # cached any-shard-armed flag: the per-record liveness tick on the
         # data hot path must cost one attribute read when nothing is armed
         self._any_liveness = False
+        # armed-path striding: the full every-hub walk runs every
+        # `liveness_stride` events or when the deadline (min armed
+        # workerTimeout / 4) lapses — not once per record/chunk
+        self._liveness_stride = max(
+            int(getattr(config, "liveness_stride", 16)), 1
+        )
+        self._liveness_tick = 0
+        self._liveness_deadline = 0.0
+        self._liveness_period = 0.0
+        # cohort gang averaging: same-cohort PS shards stage completed
+        # rounds inside a job event window and average in one stacked op
+        self.gang = None
+        if str(getattr(config, "cohort", "off")).lower() in ("auto", "on"):
+            from omldm_tpu.runtime.cohort import GangAverager
+
+            self.gang = GangAverager()
 
     def create_hub(self, request: Request, hub_id: int, dim: int) -> Hub:
         key = (request.id, hub_id)
@@ -211,8 +233,10 @@ class HubManager:
                 )
 
         hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
+        hub.node.gang = self.gang
         self.hubs[key] = hub
         self._any_liveness = self._any_liveness or hub.node.liveness_armed
+        self._refresh_liveness_period()
         # drain the pre-creation cache (FlinkHub.scala:70-87)
         cached = self._pre_creation.pop(key, None)
         if cached is not None:
@@ -244,6 +268,7 @@ class HubManager:
         self._any_liveness = any(
             h.node.liveness_armed for h in self.hubs.values()
         )
+        self._refresh_liveness_period()
 
     def route(
         self,
@@ -268,15 +293,41 @@ class HubManager:
         for hub in self.hubs.values():
             hub.flush_windows()
 
-    def check_liveness(self) -> None:
+    @property
+    def any_liveness(self) -> bool:
+        return self._any_liveness
+
+    def _refresh_liveness_period(self) -> None:
+        """Deadline half of the stride: re-walk at least every quarter of
+        the tightest armed worker timeout, however sparse the events."""
+        timeouts = [
+            h.node.worker_timeout_s
+            for h in self.hubs.values()
+            if h.node.liveness_armed
+        ]
+        self._liveness_period = min(timeouts) / 4.0 if timeouts else 0.0
+        self._liveness_deadline = 0.0  # re-walk on the next armed event
+
+    def check_liveness(self, force: bool = False) -> None:
         """Clock every liveness-armed shard's worker-deadline check. The
         job calls this from the DATA path: when a silent worker has the
         whole fleet blocked on a barrier, no protocol message ever reaches
         ``Hub.receive`` to run the check — but records keep streaming, so
         they are the clock that frees the round. One flag read when no
-        pipeline armed liveness (the default hot path)."""
+        pipeline armed liveness (the default hot path); when armed, the
+        every-hub walk is STRIDED — every `liveness_stride` events, or
+        when a quarter of the tightest worker timeout passed since the
+        last walk — so a heavy record stream pays one counter increment
+        per event, not a hub walk."""
         if not self._any_liveness:
             return
+        self._liveness_tick += 1
+        if not force and self._liveness_tick < self._liveness_stride:
+            now = time.monotonic()
+            if now < self._liveness_deadline:
+                return
+        self._liveness_tick = 0
+        self._liveness_deadline = time.monotonic() + self._liveness_period
         for hub in self.hubs.values():
             if hub.node.liveness_armed:
                 hub.node.check_liveness()

@@ -228,6 +228,23 @@ class StreamJob:
     def process_event(self, stream: str, payload: Any) -> None:
         if self.stats.terminated:
             return
+        gang = self.hub_manager.gang
+        if gang is None or not self._any_cohorts():
+            # no live cohorts: rounds average inline, the pre-cohort timing
+            self._process_event_inner(stream, payload)
+            return
+        # cohort gang-averaging window: PS rounds completed while this
+        # event processes stage their contribution matrices and average
+        # together (one stacked reduction per cohort) at window exit
+        with gang.window():
+            self._process_event_inner(stream, payload)
+
+    def _any_cohorts(self) -> bool:
+        return any(
+            s.cohorts is not None and s.cohorts.cohorts for s in self.spokes
+        )
+
+    def _process_event_inner(self, stream: str, payload: Any) -> None:
         self.events_processed += 1
         if stream == REQUEST_STREAM:
             request = (
@@ -465,7 +482,9 @@ class StreamJob:
         self.stats.mark_activity()
         # records are the liveness clock: a silent worker that has every
         # survivor blocked on a barrier stops ALL protocol traffic, so the
-        # hub-side deadline check must ride the data stream instead
+        # hub-side deadline check must ride the data stream instead. The
+        # walk itself is STRIDED inside check_liveness (every N events or
+        # on a deadline); unarmed jobs pay one flag read
         self.hub_manager.check_liveness()
         if self._pending_creates:
             pending, self._pending_creates = self._pending_creates, []
@@ -494,7 +513,22 @@ class StreamJob:
         (runtime.fast_ingest.PackedBatcher). Rows are distributed exactly as
         per-record events would be: a strided round-robin share per host
         spoke (continuing the _rr cycle, so packed and per-record events can
-        interleave) and every row to every SPMD-engine bridge."""
+        interleave) and every row to every SPMD-engine bridge.
+
+        Callers may invoke this directly (benchmarks, fused ingest), not
+        only through ``process_event``, so the cohort gang-averaging window
+        opens here too (the window is depth-counted — nesting under a
+        process_event window just defers the flush to the outer exit)."""
+        gang = self.hub_manager.gang
+        if gang is None or not self._any_cohorts():
+            self._process_packed_inner(x, y, op)
+            return
+        with gang.window():
+            self._process_packed_inner(x, y, op)
+
+    def _process_packed_inner(
+        self, x: "np.ndarray", y: "np.ndarray", op: "np.ndarray"
+    ) -> None:
         n = x.shape[0]
         if n == 0 or self.stats.terminated:
             return
@@ -517,6 +551,18 @@ class StreamJob:
         self._rr += n
         for bridge in self.spmd_bridges.values():
             bridge.handle_batch(x, y, op)
+
+    def launch_timing(self) -> dict:
+        """Pooled spoke flush-path StepTimer summary: per-launch ms
+        percentiles (p50/p99) + launches/sec across every spoke — the
+        dispatch-cost observability twin of the bytesShipped counters."""
+        from omldm_tpu.utils.tracing import StepTimer
+
+        pooled = StepTimer("spoke_flush")
+        for spoke in self.spokes:
+            for d in spoke.step_timer._durations_ms:
+                pooled.record(d)
+        return pooled.summary()
 
     def ensure_deployed(self, dim: int) -> None:
         """Deploy any Create requests still waiting on a feature width —
@@ -598,6 +644,8 @@ class StreamJob:
         for chaos in (self._chaos_up, self._chaos_down):
             if chaos is not None:
                 chaos.quiesce()
+        if self.hub_manager.gang is not None:
+            self.hub_manager.gang.flush()
         for spoke in self.spokes:
             spoke.flush_rx_windows()
         self.hub_manager.flush_windows()

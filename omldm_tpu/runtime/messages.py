@@ -65,6 +65,13 @@ def payload_size(payload: Any) -> int:
     8 bytes; containers recurse."""
     if payload is None:
         return 0
+    # fast paths for the hot piggyback shapes (curve slices are lists of
+    # (float, int) tuples — exact `type` checks skip the isinstance chain)
+    t = type(payload)
+    if t is float or t is int:
+        return 8
+    if t is tuple or t is list:
+        return sum(payload_size(p) for p in payload)
     if isinstance(payload, np.ndarray):
         return int(payload.nbytes)
     if hasattr(payload, "nbytes"):  # jax arrays, numpy scalars, EncodedLeaf

@@ -29,7 +29,9 @@ from omldm_tpu.api.requests import Request, RequestType
 from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
 from omldm_tpu.config import JobConfig
 from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.protocols.base import WorkerNode
 from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
+from omldm_tpu.runtime.cohort import CohortEngine
 from omldm_tpu.runtime.databuffers import DataSet
 from omldm_tpu.runtime.messages import (
     OP_NACK,
@@ -45,6 +47,7 @@ from omldm_tpu.runtime.vectorizer import (
     SparseVectorizer,
     Vectorizer,
 )
+from omldm_tpu.utils.tracing import StepTimer
 
 # width of the immediate-serving predict batch (forecasting records are padded
 # into this fixed shape so the predict jit never recompiles)
@@ -126,9 +129,11 @@ class SpokeNet:
         dim: int,
         config: JobConfig,
         send,
+        timer: Optional[StepTimer] = None,
     ):
         self.request = request
         self.dim = dim
+        self._timer = timer
         tc = request.training_configuration
         self.protocol = resolve_protocol(
             tc.protocol, request.learner.name, n_workers
@@ -158,6 +163,16 @@ class SpokeNet:
         self.node = make_worker_node(
             self.protocol, pipeline, worker_id, n_workers, tc, send
         )
+        # host-plane program-launch accounting (Statistics.programLaunches):
+        # the pipeline reports every dispatched program (a shared cohort
+        # launch counts once, on its triggering member); the spoke folds
+        # the tally into the pipeline's hub statistics at query/terminate
+        self.program_launches = 0
+        pipeline.on_launch = self._note_launch
+        # set on rescale absorb: the batcher then holds rows merged from a
+        # retired replica, so its pending fill is no longer a pure suffix
+        # of this spoke's stream and shared-ingest grouping must skip it
+        self.shared_taint = False
         # reliable channel (lossy-channel hardening): per-hub outgoing
         # sequence numbers + per-hub receive windows, armed per pipeline.
         # Unarmed (the default), nothing is stamped or windowed and the
@@ -197,11 +212,37 @@ class SpokeNet:
     def pipeline(self) -> MLPipeline:
         return self.node.pipeline
 
+    def _note_launch(self) -> None:
+        self.program_launches += 1
+
     def flush_batch(self) -> None:
+        if self.pipeline._cohort is not None:
+            # a deferred sync point may set `waiting`; settle before the
+            # view-vs-copy decision or a blocking node could buffer VIEWS
+            self.pipeline.settle_deferred()
+        if (
+            self.pipeline._cohort is not None
+            and self.node.consumes_batch_synchronously
+            and not getattr(self.node, "waiting", False)
+        ):
+            # staged gang dispatch: a non-waiting node consumes the batch
+            # synchronously (stage copies it into the cohort's gang
+            # buffers), so the batcher can hand out zero-copy views; the
+            # launch itself is timed inside Cohort._run_staged
+            flushed = self.batcher.flush_views()
+            if flushed is not None:
+                self.node.on_training_batch(*flushed)
+            return
         flushed = self.batcher.flush()
         if flushed is not None:
             x, y, mask = flushed
-            self.node.on_training_batch(x, y, mask)
+            if self._timer is not None and self.pipeline._cohort is None:
+                # per-pipeline dispatch timing; cohort gang launches time
+                # themselves inside Cohort._run_staged (same StepTimer)
+                with self._timer:
+                    self.node.on_training_batch(x, y, mask)
+            else:
+                self.node.on_training_batch(x, y, mask)
 
     def test_arrays(self) -> Optional[Tuple[Any, np.ndarray, np.ndarray]]:
         if self.test_set.is_empty:
@@ -234,6 +275,17 @@ class Spoke:
         self.worker_id = worker_id
         self.config = config
         self.nets: Dict[int, SpokeNet] = {}
+        # flush-path step timing: per-launch ms percentiles (StepTimer
+        # summary) emittable alongside bytesShipped — covers per-pipeline
+        # flush dispatch AND cohort gang launches
+        self.step_timer = StepTimer("spoke_flush")
+        # cohort execution engine (JobConfig.cohort): groups same-spec
+        # pipelines for gang-scheduled dispatch; None when off — every
+        # route below then takes the exact per-pipeline code path
+        engine = CohortEngine(config, timer=self.step_timer)
+        self.cohorts: Optional[CohortEngine] = (
+            engine if engine.enabled else None
+        )
         self._send_to_hub = send_to_hub
         self._emit_prediction = emit_prediction
         self._emit_response = emit_response
@@ -272,9 +324,20 @@ class Spoke:
             dim,
             self.config,
             self._make_send(request.id),
+            timer=self.step_timer,
         )
         self.nets[request.id] = net
         net.node.on_start()
+        if self.cohorts is not None:
+            self.cohorts.consider(net.pipeline)
+            # pooled pipelines may attach on a LATER create (auto
+            # threshold); attached nets are exempt from cooperative
+            # toggling, so one caught mid-pause would never be resumed —
+            # release it now
+            for other in self.nets.values():
+                if other.pipeline._cohort is not None and other.node.paused:
+                    other.node.paused = False
+                    self._drain_pause_buffer(other)
         # drain buffered records (FlinkSpoke.scala:69-80)
         if len(self.record_buffer):
             buffered = self.record_buffer.to_list()
@@ -286,7 +349,11 @@ class Spoke:
                 self.handle_packed(*block)
 
     def _delete(self, network_id: int) -> None:
-        self.nets.pop(network_id, None)
+        net = self.nets.pop(network_id, None)
+        if net is not None and self.cohorts is not None:
+            # cohort churn: the member's slot frees for reuse (compaction),
+            # no recompile; survivors keep their slots untouched
+            self.cohorts.retire(net.pipeline)
         # a deleted net can no longer generate the hub RPCs that toggle its
         # siblings: resume + drain any survivor left paused, or it would
         # starve until the terminate probe
@@ -314,6 +381,7 @@ class Spoke:
         if not self.nets:
             self.record_buffer.append(inst)
             return
+        serve_entries: List[Tuple[SpokeNet, Any]] = []
         for net in self.nets.values():
             x = net.vectorizer.vectorize(inst)
             if net.node.paused:
@@ -327,9 +395,15 @@ class Spoke:
                 )
                 continue
             if inst.operation == FORECASTING:
-                self._serve(net, inst, x)
+                # collect, then serve below: cohort members answer through
+                # ONE gang predict launch; emission keeps the nets order
+                serve_entries.append((net, x))
             else:
                 self._train(net, x, 0.0 if inst.target is None else inst.target)
+        if serve_entries:
+            self._serve_many(inst, serve_entries)
+        # gang barrier: launch every cohort's staged fits for this record
+        self._flush_cohorts()
         if inst.operation != FORECASTING:
             # poll marker every 100 training records — once per record, not
             # per hosted pipeline (FlinkSpoke.scala:83-89)
@@ -361,12 +435,26 @@ class Spoke:
             self._packed_buffer.append(("__packed__", (x, y, op), None, None))
             return
         f_idx = np.nonzero(op != 0)[0]
+        gang_nets: List[SpokeNet] = []
         for net in self.nets.values():
             if net.node.paused:
                 # hold the whole block; drains via _drain_pause_buffer
                 net.pause_buffer.append(("__packed__", (x, y, op), None, None))
                 continue
+            if net.pipeline._cohort is not None:
+                # cohort members advance in LOCKSTEP below so same-cohort
+                # flushes stage into shared gang launches (per-net row
+                # order, holdout cycle and flush points are identical to
+                # the solo path; they are exempt from cooperative pause —
+                # gang scheduling IS the fairness mechanism)
+                gang_nets.append(net)
+                continue
             self._process_packed_for_net(net, x, y, f_idx)
+        if len(gang_nets) == 1:
+            self._process_packed_for_net(gang_nets[0], x, y, f_idx)
+        elif gang_nets:
+            self._process_packed_gang(gang_nets, x, y, f_idx)
+        self._flush_cohorts()
         nt = n - int(f_idx.size)
         if nt:
             pc = self._poll_counter
@@ -408,6 +496,40 @@ class Spoke:
             val[i, : nz.size] = rows[i, nz]
         return idx, val
 
+    def _holdout_filter(
+        self, net: SpokeNet, tx: np.ndarray, ty: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized 8-of-10 holdout split over a packed segment; evicted
+        test points re-enter the training flow at the slot of the row that
+        evicted them. Identity when test mode is off."""
+        if not self.config.test:
+            return tx, ty
+        n = tx.shape[0]
+        c = (net.holdout_count + np.arange(n)) % 10
+        net.holdout_count += n
+        test_mask = c >= 8
+        keep_idx = np.nonzero(~test_mask)[0]
+        ev_x: List[np.ndarray] = []
+        ev_y: List[float] = []
+        ev_pos: List[int] = []
+        for i in np.nonzero(test_mask)[0]:
+            evicted = net.test_set.append((tx[i].copy(), float(ty[i])))
+            if evicted is not None:
+                ev_x.append(evicted[0])
+                ev_y.append(evicted[1])
+                ev_pos.append(int(i))
+        if ev_pos:
+            pos = np.concatenate([keep_idx, np.asarray(ev_pos)])
+            order = np.argsort(pos, kind="stable")
+            tx = np.concatenate([tx[keep_idx], np.stack(ev_x)])[order]
+            ty = np.concatenate(
+                [ty[keep_idx], np.asarray(ev_y, np.float32)]
+            )[order]
+        else:
+            tx = tx[keep_idx]
+            ty = ty[keep_idx]
+        return tx, ty
+
     def _train_packed(self, net: SpokeNet, tx: np.ndarray, ty: np.ndarray) -> None:
         n = tx.shape[0]
         if n == 0:
@@ -421,32 +543,7 @@ class Spoke:
                 self._train(net, (sidx[i], sval[i]), float(ty[i]))
             return
         tx = self._adapt_width(tx, net.dim)
-        if self.config.test:
-            # vectorized 8-of-10 holdout split; evicted test points re-enter
-            # the training flow at the slot of the row that evicted them
-            c = (net.holdout_count + np.arange(n)) % 10
-            net.holdout_count += n
-            test_mask = c >= 8
-            keep_idx = np.nonzero(~test_mask)[0]
-            ev_x: List[np.ndarray] = []
-            ev_y: List[float] = []
-            ev_pos: List[int] = []
-            for i in np.nonzero(test_mask)[0]:
-                evicted = net.test_set.append((tx[i].copy(), float(ty[i])))
-                if evicted is not None:
-                    ev_x.append(evicted[0])
-                    ev_y.append(evicted[1])
-                    ev_pos.append(int(i))
-            if ev_pos:
-                pos = np.concatenate([keep_idx, np.asarray(ev_pos)])
-                order = np.argsort(pos, kind="stable")
-                tx = np.concatenate([tx[keep_idx], np.stack(ev_x)])[order]
-                ty = np.concatenate(
-                    [ty[keep_idx], np.asarray(ev_y, np.float32)]
-                )[order]
-            else:
-                tx = tx[keep_idx]
-                ty = ty[keep_idx]
+        tx, ty = self._holdout_filter(net, tx, ty)
         i = 0
         total = tx.shape[0]
         while i < total:
@@ -528,11 +625,19 @@ class Spoke:
         (FlinkNetwork.scala:48-149,151-240). The ResponseMerger re-assembles
         buckets and averages metrics across workers."""
         net.flush_batch()
+        self._flush_cohorts()
         test = net.test_arrays()
         if test is not None:
             loss, score = net.pipeline.evaluate(*test)
         else:
             loss, score = 0.0, 0.0
+        # fold the spoke-side launch tally into the pipeline's hub stats
+        # (queries and the terminate probe both pass through here)
+        if self._note_wire is not None and net.program_launches:
+            self._note_wire(
+                net.request.id, 0, "program_launches", net.program_launches
+            )
+            net.program_launches = 0
         desc = net.pipeline.describe()
         qstats = net.node.query_stats()
 
@@ -578,6 +683,7 @@ class Spoke:
                 net.node.paused = False
             self._drain_pause_buffer(net)
             net.flush_batch()
+            self._flush_cohorts()
             net.node.on_flush()
             self.emit_query_response(net, TERMINATION_RESPONSE_ID)
 
@@ -623,9 +729,15 @@ class Spoke:
         # cooperative multi-pipeline fairness: every hub RPC for one net
         # TOGGLES the others (FlinkSpoke.scala:127-131) — alternating
         # pause/resume yields the spoke between hosted pipelines; a net
-        # that just resumed drains the records buffered while paused
+        # that just resumed drains the records buffered while paused.
+        # Cohort-ATTACHED nets are exempt: they advance in gang lockstep,
+        # which provides the fairness the toggle approximates (and a
+        # toggle storm across a 64-member cohort would thrash every
+        # member through pause buffers on each sync reply)
         for other_id, other in self.nets.items():
             if other_id == network_id:
+                continue
+            if other.pipeline._cohort is not None:
                 continue
             other.node.toggle()
             if not other.node.paused:
@@ -658,6 +770,197 @@ class Spoke:
         if prev < n:
             self._train_packed(net, x[prev:], y[prev:])
 
+    # --- cohort gang dispatch (runtime.cohort) ---------------------------
+
+    def _flush_cohorts(self) -> None:
+        if self.cohorts is not None:
+            self.cohorts.flush()
+
+    def _process_packed_gang(self, nets, x, y, f_idx) -> None:
+        """Lockstep twin of ``_process_packed_for_net`` over ALL nets:
+        segments between forecasts gang-train, forecasts gang-serve at
+        their stream position."""
+        n = x.shape[0]
+        prev = 0
+        for f in f_idx:
+            f = int(f)
+            if f > prev:
+                self._train_packed_gang(nets, x[prev:f], y[prev:f])
+            self._serve_packed_gang(nets, x, f)
+            prev = f + 1
+        if prev < n:
+            self._train_packed_gang(nets, x[prev:], y[prev:])
+
+    def _train_packed_gang(
+        self, nets: List[SpokeNet], tx: np.ndarray, ty: np.ndarray
+    ) -> None:
+        """Feed a training segment to every net in batch-size strides:
+        each net's row order, holdout cycle and flush points are identical
+        to its solo path — only the flush ORDER across nets interleaves,
+        so same-cohort flushes stage into one gang launch (forced by the
+        members' own sync points, or at the block's gang barrier)."""
+        if tx.shape[0] == 0:
+            return
+        if not self.config.test:
+            # shared-ingest fast path: identical-stream cohort members
+            # batch through ONE leader batcher; nets it cannot take stay
+            # in the stride loop below
+            nets = self._train_packed_shared_groups(nets, tx, ty)
+            if not nets:
+                return
+        feeds = []
+        for net in nets:
+            if net.sparse:
+                # sparse nets keep the row-wise path (no gang kernels)
+                self._train_packed(net, tx, ty)
+                continue
+            ntx = self._adapt_width(tx, net.dim)
+            ftx, fty = self._holdout_filter(net, ntx, ty)
+            feeds.append([net, ftx, fty, 0])
+        pending = True
+        while pending:
+            pending = False
+            for feed in feeds:
+                net, ftx, fty, cur = feed
+                if cur >= ftx.shape[0]:
+                    continue
+                cur += net.batcher.add_many(ftx[cur:], fty[cur:])
+                feed[3] = cur
+                if net.batcher.full:
+                    net.flush_batch()
+                if cur < ftx.shape[0]:
+                    pending = True
+
+    def _train_packed_shared_groups(
+        self, nets: List[SpokeNet], tx: np.ndarray, ty: np.ndarray
+    ) -> List[SpokeNet]:
+        """Feed identical-stream cohort members through ONE leader batcher
+        (same-object flushes let the cohort stage ONE copy and launch the
+        shared-input program). Returns the nets the shared path cannot
+        take. Eligibility: untainted attached members of the same cohort
+        with equal batcher fill — every member then holds the SAME pending
+        stream suffix, so the leader's batches are bitwise everyone's."""
+        groups: Dict[Any, List[SpokeNet]] = {}
+        rest: List[SpokeNet] = []
+        for net in nets:
+            cohort = net.pipeline._cohort
+            if (
+                cohort is not None
+                and not net.sparse
+                and not net.shared_taint
+                and net.dim == tx.shape[1]
+                and net.node.consumes_batch_synchronously
+            ):
+                groups.setdefault(cohort, []).append(net)
+            else:
+                rest.append(net)
+        for members in groups.values():
+            fills = {len(m.batcher) for m in members}
+            sizes = {m.batcher.batch_size for m in members}
+            if len(members) < 2 or len(fills) != 1 or len(sizes) != 1:
+                rest.extend(members)
+                continue
+            self._train_packed_shared(members, tx, ty)
+        return rest
+
+    def _train_packed_shared(
+        self, members: List[SpokeNet], tx: np.ndarray, ty: np.ndarray
+    ) -> None:
+        leader = members[0]
+        batcher = leader.batcher
+        i = 0
+        total = tx.shape[0]
+        while i < total:
+            i += batcher.add_many(tx[i:], ty[i:])
+            if batcher.full:
+                flushed = batcher.flush_views()
+                x, y, m = flushed
+                for net in members:
+                    # settle deferred sync points BEFORE the view-vs-copy
+                    # decision: one may flip this member to waiting
+                    net.pipeline.settle_deferred()
+                    if getattr(net.node, "waiting", False):
+                        # blocked batches must own their arrays; everyone
+                        # else consumes (stages a copy) synchronously
+                        net.node.on_training_batch(x.copy(), y.copy(), m)
+                    else:
+                        net.node.on_training_batch(x, y, m)
+        for net in members[1:]:
+            net.batcher.clone_pending_from(batcher)
+
+    def _gang_predict_ok(self, net: SpokeNet) -> bool:
+        """Gang forecast serving bypasses ``node.on_forecast_batch`` with a
+        bit-identical batched predict — only valid for attached dense nets
+        whose node keeps the base (predict-with-local-model) behavior."""
+        return (
+            not net.sparse
+            and net.pipeline._cohort is not None
+            and type(net.node).on_forecast_batch
+            is WorkerNode.on_forecast_batch
+        )
+
+    def _gang_predictions(
+        self, entries: List[Tuple[SpokeNet, np.ndarray]]
+    ) -> Dict[int, float]:
+        """One padded predict launch per cohort with >= 2 participants;
+        returns {id(net): prediction} for the nets served by a gang."""
+        groups: Dict[Any, List[Tuple[SpokeNet, np.ndarray]]] = {}
+        for net, xb in entries:
+            groups.setdefault(net.pipeline._cohort, []).append((net, xb))
+        out: Dict[int, float] = {}
+        for cohort, items in groups.items():
+            if len(items) < 2:
+                continue
+            rows = [(net.pipeline._slot, xb) for net, xb in items]
+            preds = cohort.predict_rows(rows)
+            for (net, _), (slot, _) in zip(items, rows):
+                out[id(net)] = float(preds[slot, 0])
+        return out
+
+    def _serve_many(self, inst: DataInstance, entries) -> None:
+        """Serve one forecast record to many nets, ganging cohort members
+        through one predict launch; emission keeps the nets order."""
+        gang_in = []
+        for net, x in entries:
+            if self._gang_predict_ok(net):
+                xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+                xb[0] = x
+                gang_in.append((net, xb))
+        ganged = self._gang_predictions(gang_in) if gang_in else {}
+        for net, x in entries:
+            pred = ganged.get(id(net))
+            if pred is None:
+                self._serve(net, inst, x)
+            else:
+                self._emit_prediction(
+                    Prediction(net.request.id, inst, pred)
+                )
+
+    def _serve_packed_gang(self, nets: List[SpokeNet], x: np.ndarray, f: int) -> None:
+        """Serve packed-row forecast ``f`` to every net at its stream
+        position (gang predict for cohort members, solo path otherwise)."""
+        gang_in = []
+        for net in nets:
+            if self._gang_predict_ok(net):
+                row = self._adapt_width(x[f : f + 1], net.dim)[0]
+                xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+                xb[0] = row
+                gang_in.append((net, xb))
+        ganged = self._gang_predictions(gang_in) if gang_in else {}
+        for net in nets:
+            pred = ganged.get(id(net))
+            if pred is None:
+                self._serve_packed(net, x, np.asarray([f]))
+            else:
+                row = self._adapt_width(x[f : f + 1], net.dim)[0]
+                inst = DataInstance(
+                    numerical_features=row.tolist(),
+                    operation=FORECASTING,
+                )
+                self._emit_prediction(
+                    Prediction(net.request.id, inst, pred)
+                )
+
     def _drain_pause_buffer(self, net: SpokeNet) -> None:
         if net.pause_buffer.is_empty:
             return
@@ -686,13 +989,22 @@ class Spoke:
         pre-creation buffers concatenate — the mergingDataBuffers +
         wrapper-merge semantics of the reference's rescale path
         (SpokeLogic.scala:37-50, FlinkSpoke.scala:289-330)."""
+        # settle gang state on both sides first: the retiring spoke's
+        # cohorts dissolve (members get their state back for the merge);
+        # survivors keep their cohorts — merge_from edits flow through the
+        # member checkout path
+        if retired.cohorts is not None:
+            retired.cohorts.detach_all()
+        self._flush_cohorts()
         for net_id, rnet in retired.nets.items():
             snet = self.nets.get(net_id)
             if snet is None:
                 # this spoke never hosted the pipeline (shouldn't happen in
                 # a job-managed rescale): adopt the retiring replica whole
+                rnet.shared_taint = True
                 self.nets[net_id] = rnet
                 continue
+            snet.shared_taint = True
             # pending rows train into the surviving replica: the batcher's
             # partial fill AND any batches the retiring node buffered while
             # waiting on a protocol sync (SyncingWorker._blocked — dropping

@@ -234,3 +234,25 @@ class MicroBatcher:
         y[self._n :] = 0.0
         self._n = 0
         return x, y, mask
+
+    def clone_pending_from(self, other: "MicroBatcher") -> None:
+        """Adopt another batcher's pending rows — shared-ingest cohort
+        members re-sync to the leader batcher's state at segment end."""
+        n = other._n
+        self._x[:n] = other._x[:n]
+        self._y[:n] = other._y[:n]
+        self._n = n
+
+    def flush_views(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Zero-copy flush: padded VIEWS of the internal buffers (valid only
+        until the next add) + a fresh mask. For consumers that copy the
+        rows synchronously — the cohort staging path writes them straight
+        into its gang buffers, skipping one [B, D] copy per flush."""
+        if self._n == 0:
+            return None
+        mask = np.zeros((self.batch_size,), np.float32)
+        mask[: self._n] = 1.0
+        self._x[self._n :] = 0.0
+        self._y[self._n :] = 0.0
+        self._n = 0
+        return self._x, self._y, mask

@@ -38,15 +38,18 @@ class StepTimer:
     def __init__(self, name: str = "step"):
         self.name = name
         self._durations_ms: List[float] = []
-        self._t0: Optional[float] = None
+        # a stack: one shared timer may wrap NESTED steps (a flush whose
+        # protocol reply synchronously drains another pipeline's flush)
+        self._starts: List[float] = []
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._starts.append(time.perf_counter())
         return self
 
     def __exit__(self, *exc):
-        self._durations_ms.append((time.perf_counter() - self._t0) * 1000.0)
-        self._t0 = None
+        self._durations_ms.append(
+            (time.perf_counter() - self._starts.pop()) * 1000.0
+        )
         return False
 
     def record(self, duration_ms: float) -> None:
