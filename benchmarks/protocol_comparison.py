@@ -86,7 +86,7 @@ def _codec_seconds(job) -> float:
 
 def run_one(protocol: str, x, y, parallelism: int, batch: int,
             engine: str = "host", codec: str = "none", chaos: str = "",
-            sync_every: int = 4):
+            sync_every: int = 4, guard: bool = False):
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -112,6 +112,8 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
     }
     if codec != "none":
         create["trainingConfiguration"]["comm"] = {"codec": codec}
+    if guard:
+        create["trainingConfiguration"]["guard"] = True
     if engine == "spmd":
         create["trainingConfiguration"]["engine"] = "spmd"
         create["trainingConfiguration"]["stageChain"] = 4
@@ -140,6 +142,13 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "duplicates_dropped": stats.duplicates_dropped,
         "gaps_resynced": stats.gaps_resynced,
         "quorum_releases": stats.quorum_releases,
+        # model-integrity guard counters (trainingConfiguration.guard):
+        # zero on guard-off and clean guarded runs, nonzero when the
+        # admission / rollback / quarantine / eviction paths engage
+        "deltas_rejected": stats.deltas_rejected,
+        "rollbacks_performed": stats.rollbacks_performed,
+        "records_quarantined": stats.records_quarantined,
+        "members_evicted": stats.members_evicted,
     }
     if codec != "none":
         out["codec_seconds"] = round(_codec_seconds(job), 4)
@@ -428,6 +437,15 @@ def main() -> None:
              "drop+dup+reorder chaos; NONZERO EXIT if a run crashes or "
              "leaves the fault-free loss envelope",
     )
+    ap.add_argument(
+        "--guard-smoke", action="store_true",
+        help="CI gate: model-integrity guard end to end — a poisoned run "
+             "(seeded NaN + exploding deltas) must finish inside the "
+             "fault-free score envelope with the guard counters engaged, "
+             "and a guard-armed CLEAN run must stay within 3%% of "
+             "guard-off throughput on the packed host path; NONZERO EXIT "
+             "otherwise",
+    )
     args = ap.parse_args()
 
     import os
@@ -507,6 +525,98 @@ def main() -> None:
             "per_pipeline": per,
             "cohort": coh,
             "holdout_parity": {"per_pipeline": pp, "cohort": pc},
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
+
+    if args.guard_smoke:
+        # CI gate (ISSUE 7 acceptance): (a) seeded poison injection — NaN
+        # and exploding worker deltas on the hub<->spoke bridge — against
+        # guard-armed Synchronous + Asynchronous runs must finish with the
+        # admission counters engaged and the final score inside the 0.05
+        # fault-free envelope; (b) arming the guard on a CLEAN stream must
+        # cost <= 3% throughput on the packed CPU host path (4 paired
+        # off/on trials, best pair ratio — the python-dispatch baseline is
+        # noisy on shared CI boxes) and must not move the score at all.
+        records = min(args.records, 48_000)
+        par = min(args.parallelism, 4)
+        batch = min(args.batch, 64)
+        rng = np.random.RandomState(11)
+        w = np.random.RandomState(42).randn(28)
+        gx = rng.randn(records, 28).astype(np.float32)
+        gy = (gx @ w > 0).astype(np.float32)
+        poison_spec = "seed=7,up.nan=0.02,up.explode=0.02"
+        failures = []
+        out = {}
+        # warmup compiles both program families (guarded + unguarded)
+        run_one("Synchronous", gx[:2048], gy[:2048], par, batch)
+        run_one("Synchronous", gx[:2048], gy[:2048], par, batch, guard=True)
+        for protocol in ("Synchronous", "Asynchronous"):
+            # paired back-to-back A/B trials: this box is share-throttled
+            # (+-25%, BASELINE notes), so each off/on pair samples the
+            # same throttle window and the gate takes the BEST pair ratio
+            # — throttle noise only ever inflates a pair's ratio, so the
+            # minimum over pairs is the tightest available estimate of
+            # the systematic guard overhead
+            clean_off = clean_on = None
+            pair_ratios = []
+            for _trial in range(4):
+                r_off = run_one(protocol, gx, gy, par, batch)
+                r_on = run_one(protocol, gx, gy, par, batch, guard=True)
+                pair_ratios.append(
+                    r_off["examples_per_sec"]
+                    / max(r_on["examples_per_sec"], 1e-9)
+                )
+                if clean_off is None or (
+                    r_off["examples_per_sec"]
+                    > clean_off["examples_per_sec"]
+                ):
+                    clean_off = r_off
+                if clean_on is None or (
+                    r_on["examples_per_sec"] > clean_on["examples_per_sec"]
+                ):
+                    clean_on = r_on
+            poisoned = run_one(
+                protocol, gx, gy, par, batch, guard=True, chaos=poison_spec
+            )
+            overhead = min(pair_ratios)
+            row = {
+                "clean_guard_off": clean_off,
+                "clean_guard_on": clean_on,
+                "poisoned_guard_on": poisoned,
+                "guard_overhead_x": round(overhead, 3),
+                "poisoned_score_delta": round(
+                    poisoned["score"] - clean_off["score"], 4
+                ),
+            }
+            out[protocol] = row
+            if clean_on["score"] != clean_off["score"]:
+                failures.append(
+                    f"{protocol}: guard-armed clean score "
+                    f"{clean_on['score']} != guard-off {clean_off['score']}"
+                )
+            if overhead > 1.03:
+                failures.append(
+                    f"{protocol}: guard-armed clean throughput "
+                    f"{overhead:.3f}x slower than guard-off (> 3% bar)"
+                )
+            if poisoned["deltas_rejected"] == 0:
+                failures.append(
+                    f"{protocol}: poison injection never engaged the "
+                    "admission counters — the envelope check is vacuous"
+                )
+            if abs(row["poisoned_score_delta"]) > 0.05:
+                failures.append(
+                    f"{protocol}: poisoned score delta "
+                    f"{row['poisoned_score_delta']} outside the 0.05 envelope"
+                )
+        print(json.dumps({
+            "config": "protocol_comparison_guard_smoke",
+            "records": records,
+            "poison_spec": poison_spec,
+            **out,
             "failures": failures,
         }))
         if failures:

@@ -232,6 +232,9 @@ def _apply_kafka_sinks(job: StreamJob, flags: Dict[str, str], producer_sinks) ->
             None if "performanceOut" in flags else producer_sinks.on_performance
         ),
     )
+    # quarantined records/requests publish to the deadLetters topic in
+    # addition to the job's in-memory ring / --deadLetterPath file
+    job.dead_letter.publish = producer_sinks.on_dead_letter
 
 
 def _kafka_loop(job: StreamJob, events, flags: Dict[str, str], profile: Dict) -> None:

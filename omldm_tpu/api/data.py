@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-from typing import Any, Mapping, Optional, Sequence
+from typing import Any, Mapping, Optional, Sequence, Tuple
 
 TRAINING = "training"
 FORECASTING = "forecasting"
@@ -46,12 +46,13 @@ class DataInstance:
     operation: str = TRAINING
     metadata: Optional[Mapping[str, Any]] = None
 
-    def is_valid(self) -> bool:
-        """Validation mirroring the reference's ``isValid`` check applied in
-        DataInstanceParser.scala:13-21: the record must carry features and a
-        known operation."""
+    def invalid_reason(self) -> Optional[str]:
+        """Why this record fails the reference's ``isValid`` check
+        (DataInstanceParser.scala:13-21), or None when usable. The reason
+        code feeds the dead-letter sink (runtime/deadletter) so rejected
+        records are quarantined with a cause instead of silently dropped."""
         if self.operation not in (TRAINING, FORECASTING):
-            return False
+            return "unknown_operation"
         has_features = any(
             f is not None and len(f) > 0
             for f in (
@@ -61,7 +62,7 @@ class DataInstance:
             )
         )
         if not has_features:
-            return False
+            return "no_features"
         # Python's json.loads accepts bare NaN/Infinity literals that the
         # reference's Jackson parser rejects; a single non-finite value would
         # poison model parameters, so reject them here.
@@ -70,37 +71,58 @@ class DataInstance:
                 if f is not None and any(
                     v is None or not math.isfinite(v) for v in f
                 ):
-                    return False
+                    return "non_finite_feature"
             if self.target is not None and not math.isfinite(self.target):
-                return False
+                return "non_finite_target"
         except TypeError:
             # non-numeric feature elements (e.g. strings in numericalFeatures)
-            return False
-        return True
+            return "non_numeric_feature"
+        return None
+
+    def is_valid(self) -> bool:
+        """Validation mirroring the reference's ``isValid`` check applied in
+        DataInstanceParser.scala:13-21: the record must carry features and a
+        known operation."""
+        return self.invalid_reason() is None
 
     # --- JSON codec (Jackson-compatible camelCase field names) ---
+
+    @classmethod
+    def parse(
+        cls, text: str
+    ) -> Tuple[Optional["DataInstance"], Optional[str]]:
+        """Parse a JSON record into ``(instance, rejection_reason)``.
+
+        Exactly one of the pair is non-None, except for EOS markers and
+        blank lines which return ``(None, None)`` — they are protocol
+        markers (DataInstanceParser.scala:14), not malformed input, and
+        must not be quarantined."""
+        text = text.strip()
+        if not text or text == EOS or text == f'"{EOS}"':
+            return None, None
+        try:
+            obj = json.loads(text)
+        except (json.JSONDecodeError, ValueError):
+            return None, "malformed_json"
+        if not isinstance(obj, dict):
+            return None, "not_an_object"
+        try:
+            inst = cls.from_dict(obj)
+        except (TypeError, ValueError):
+            # e.g. non-numeric target: the reference's Jackson deserializer
+            # fails and the record is dropped (DataInstanceDeserializer.scala:24-33)
+            return None, "bad_field_type"
+        reason = inst.invalid_reason()
+        if reason is not None:
+            return None, reason
+        return inst, None
 
     @classmethod
     def from_json(cls, text: str) -> Optional["DataInstance"]:
         """Parse a JSON record; returns None for invalid records and the EOS
         marker, mirroring DataInstanceParser.scala:12-22 (drops invalid, drops
         "EOS", swallows parse errors)."""
-        text = text.strip()
-        if not text or text == EOS or text == f'"{EOS}"':
-            return None
-        try:
-            obj = json.loads(text)
-        except (json.JSONDecodeError, ValueError):
-            return None
-        if not isinstance(obj, dict):
-            return None
-        try:
-            inst = cls.from_dict(obj)
-        except (TypeError, ValueError):
-            # e.g. non-numeric target: the reference's Jackson deserializer
-            # fails and the record is dropped (DataInstanceDeserializer.scala:24-33)
-            return None
-        return inst if inst.is_valid() else None
+        return cls.parse(text)[0]
 
     @classmethod
     def from_dict(cls, obj: Mapping[str, Any]) -> "DataInstance":

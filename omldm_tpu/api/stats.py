@@ -56,6 +56,21 @@ class Statistics:
     # so the cross-pipeline SUM equals real program launches); counted
     # spoke-side and folded in at query/terminate time
     program_launches: int = 0
+    # model-integrity guard counters (zero with trainingConfiguration.guard
+    # unset, the default): worker updates the hub-side admission boundary
+    # rejected before round accounting (non-finite / norm-exploded),
+    # last-known-good rollbacks the worker-side guard performed, and
+    # cohort members evicted to solo execution after a divergence trip
+    # (omldm_tpu.guard; protocols/base.HubNode.guard_admit, runtime/spoke)
+    deltas_rejected: int = 0
+    rollbacks_performed: int = 0
+    members_evicted: int = 0
+    # malformed / validation-rejected records routed to the dead-letter
+    # sink instead of being silently dropped (runtime/deadletter). The
+    # count is JOB-level (a dropped record would have reached every
+    # pipeline) and is mirrored into each pipeline's statistics at
+    # terminate — it does NOT sum across pipelines to the record count
+    records_quarantined: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -72,6 +87,10 @@ class Statistics:
         gaps_resynced: int = 0,
         quorum_releases: int = 0,
         program_launches: int = 0,
+        deltas_rejected: int = 0,
+        rollbacks_performed: int = 0,
+        members_evicted: int = 0,
+        records_quarantined: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127)."""
         self.models_shipped += models_shipped
@@ -82,6 +101,10 @@ class Statistics:
         self.gaps_resynced += gaps_resynced
         self.quorum_releases += quorum_releases
         self.program_launches += program_launches
+        self.deltas_rejected += deltas_rejected
+        self.rollbacks_performed += rollbacks_performed
+        self.members_evicted += members_evicted
+        self.records_quarantined += records_quarantined
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -126,6 +149,12 @@ class Statistics:
             gaps_resynced=self.gaps_resynced + other.gaps_resynced,
             quorum_releases=self.quorum_releases + other.quorum_releases,
             program_launches=self.program_launches + other.program_launches,
+            deltas_rejected=self.deltas_rejected + other.deltas_rejected,
+            rollbacks_performed=self.rollbacks_performed
+            + other.rollbacks_performed,
+            members_evicted=self.members_evicted + other.members_evicted,
+            records_quarantined=self.records_quarantined
+            + other.records_quarantined,
             fitted=self.fitted + other.fitted,
             mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
             score=self.score + other.score,
@@ -150,6 +179,10 @@ class Statistics:
             "gapsResynced": self.gaps_resynced,
             "quorumReleases": self.quorum_releases,
             "programLaunches": self.program_launches,
+            "deltasRejected": self.deltas_rejected,
+            "rollbacksPerformed": self.rollbacks_performed,
+            "membersEvicted": self.members_evicted,
+            "recordsQuarantined": self.records_quarantined,
             "numOfBlocks": self.num_of_blocks,
             "fitted": self.fitted,
             "learningCurve": self.learning_curve,

@@ -69,11 +69,25 @@ class JobConfig:
     # rides Kafka at-least-once and hopes) ---
     # Deterministic chaos spec for the in-process hub<->spoke bridge, e.g.
     # "seed=7,drop=0.05,dup=0.05,reorder=0.1,window=4" (per-direction
-    # overrides: "up.drop=...", "down.dup=..."). Empty (default) = fault
+    # overrides: "up.drop=...", "down.dup=..."). Corruption classes
+    # "nan"/"explode" plant seeded NaNs / 1e12 norm explosions in shipped
+    # parameter vectors ("poison" mutates source records, Kafka route) —
+    # the model-integrity guard's fault drivers. Empty (default) = fault
     # free; the OMLDM_CHAOS env var arms it too (reaches worker
     # subprocesses). When armed, the reliable channel (sequence numbers,
     # receive windows, NACK/resync) arms itself per pipeline.
     chaos: str = ""
+
+    # --- model integrity (omldm_tpu.guard / runtime.deadletter; no
+    # reference counterpart: the reference silently drops records its
+    # parsers reject, DataPointParser.scala:13-21) ---
+    # Dead-letter JSONL file for malformed / validation-rejected records
+    # and requests ("" = bounded in-memory quarantine only). Every entry
+    # carries a reason code; the per-pipeline guard itself is armed via
+    # trainingConfiguration.guard, not here.
+    dead_letter_path: str = ""
+    # In-memory quarantine ring size (oldest entries evict).
+    dead_letter_cap: int = 10_000
 
     # --- multi-tenant cohort execution (runtime.cohort; no reference
     # counterpart: the reference steps every pipeline's PipelineMap entry

@@ -63,8 +63,22 @@ def int8_affine_encode(
         return x.astype(np.uint8), np.float32(1.0), np.float32(0.0)
     lo = np.float32(x.min())
     hi = np.float32(x.max())
+    if not (np.isfinite(lo) and np.isfinite(hi)):
+        # FAIL LOUDLY: quantizing a non-finite leaf would silently encode
+        # garbage (NaN -> rint -> undefined uint8) and ship it as a
+        # plausible-looking model. Non-finite state is a sender-side
+        # corruption the model-integrity guard exists to catch BEFORE the
+        # ship boundary; the codec must never launder it.
+        raise ValueError(
+            "int8 codec: non-finite values in leaf "
+            f"(min={x.min()!r}, max={x.max()!r}); refusing to encode"
+        )
     scale = np.float32((hi - lo) / 255.0)
     if not np.isfinite(scale) or scale <= 0:
+        # degenerate range (constant/zero leaf, or a subnormal span whose
+        # /255 underflows): scale 1 with zero-point ``lo`` encodes every
+        # element as q=0 -> decode == lo exactly — a lossless passthrough
+        # that leaves NO error-feedback residual behind
         scale = np.float32(1.0)
     q = np.clip(np.rint((x - lo) / scale), 0, 255).astype(np.uint8)
     return q, scale, lo

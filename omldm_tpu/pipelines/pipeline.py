@@ -80,6 +80,42 @@ _LRU_CAP = int(os.environ.get("OMLDM_JIT_CACHE_CAP", "64"))
 _JIT_CACHE: _LRUCache = _LRUCache(_LRU_CAP)
 
 
+def _param_health(params):
+    """In-program health reduction over the parameter leaves: ONE scalar,
+    the total squared L2 norm. A single NaN/Inf anywhere in the params
+    makes the sum itself non-finite, so this one number carries BOTH
+    divergence signals (non-finite state, exploding norm) — one extra
+    program output instead of two, which matters at tiny-launch dispatch
+    scale (the <= 3% guard-overhead bar). Fused into the guarded fit
+    programs so detection costs no extra XLA launch; non-float leaves
+    (integer counters) are skipped — corruption is a float phenomenon."""
+    sq_norm = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(params):
+        leaf = jnp.asarray(leaf)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        sq_norm = sq_norm + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return sq_norm
+
+
+def _guard_wrap(fit_impl, fit_many_impl):
+    """Guarded twins of the fit programs: the state math is the SAME
+    impls unchanged; only (loss) grows to (loss, sq_norm). The health of
+    the FINAL state subsumes intermediate steps in a chained fit (NaN
+    sticks; an exploded norm does not shrink back), so fit_many reduces
+    health once after the scan, not per step."""
+
+    def fit_guarded(state, x, y, mask):
+        new_state, loss = fit_impl(state, x, y, mask)
+        return new_state, (loss, _param_health(new_state["params"]))
+
+    def fit_many_guarded(state, xs, ys, masks):
+        new_state, losses = fit_many_impl(state, xs, ys, masks)
+        return new_state, (losses, _param_health(new_state["params"]))
+
+    return fit_guarded, fit_many_guarded
+
+
 def _build_impls(learner, preps, per_record):
     """Pure step implementations closing over stateless modules only."""
 
@@ -142,6 +178,7 @@ class MLPipeline:
         dim: int = 0,
         rng: Optional[jax.Array] = None,
         per_record: bool = False,
+        guard=None,
     ):
         self.learner: Learner = make_learner(learner_spec)
         self.preps: List[Preprocessor] = [
@@ -155,6 +192,18 @@ class MLPipeline:
             )
         self.dim = dim
         self.per_record = per_record
+        # model-integrity guard (trainingConfiguration.guard, parsed by
+        # omldm_tpu.guard.guard_config): when armed, the fit programs fuse
+        # an isfinite + param-norm health reduction into every launch and
+        # this ModelGuard holds the lazy results + the LKG rollback ring.
+        # None (default, and always for host-side learners whose state the
+        # host already sees) = the exact pre-guard programs and code paths.
+        self.guard = None
+        if guard is not None and not self.learner.host_side:
+            from omldm_tpu.guard import ModelGuard
+
+            self.guard = ModelGuard(guard)
+        guarded = self.guard is not None
         # cohort co-hosting (runtime.cohort): when attached, `_cohort` owns
         # the authoritative state (stacked with its same-spec siblings) and
         # fit/predict/flat-params route through gang launches; `_state` is
@@ -213,6 +262,9 @@ class MLPipeline:
                 tuple((type(p).__name__, _freeze(p.hp)) for p in self.preps),
                 dim,
                 per_record,
+                # guarded fit programs carry extra health outputs, so they
+                # must never share a cache slot with unguarded ones
+                guarded,
             )
             self.cache_key = key
             cached = _JIT_CACHE.get(key)
@@ -220,6 +272,8 @@ class MLPipeline:
                 fit_i, pred_i, eval_i, many_i = _build_impls(
                     self.learner, self.preps, per_record
                 )
+                if guarded:
+                    fit_i, many_i = _guard_wrap(fit_i, many_i)
                 cached = (
                     jax.jit(fit_i, donate_argnums=0),
                     jax.jit(pred_i),
@@ -261,7 +315,12 @@ class MLPipeline:
         gang launch and return an equally lazy loss."""
         n = int(np.asarray(mask).sum())
         if self._cohort is not None:
+            # guarded members get their health from the gang launch
             loss = self._cohort.stage_fit(self._slot, x, y, mask)
+        elif self.guard is not None:
+            self._count_launch()
+            self._state, (loss, sq_norm) = self._fit(self._state, x, y, mask)
+            self.guard.note(sq_norm)
         else:
             self._count_launch()
             self._state, loss = self._fit(self._state, x, y, mask)
@@ -284,6 +343,12 @@ class MLPipeline:
             return jnp.stack([jnp.asarray(l) for l in losses])
         if self._cohort is not None:
             losses = self._cohort.stage_fit_many(self._slot, xs, ys, masks)
+        elif self.guard is not None:
+            self._count_launch()
+            self._state, (losses, sq_norm) = self._fit_many(
+                self._state, xs, ys, masks
+            )
+            self.guard.note(sq_norm, fits=int(np.asarray(xs).shape[0]))
         else:
             self._count_launch()
             self._state, losses = self._fit_many(self._state, xs, ys, masks)

@@ -26,6 +26,7 @@ import numpy as np
 
 from omldm_tpu.api.requests import TrainingConfiguration
 from omldm_tpu.api.stats import Statistics
+from omldm_tpu.guard import admission_reason, guard_config
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.runtime.codec import make_transport_codec
 from omldm_tpu.runtime.messages import (
@@ -83,9 +84,27 @@ class WorkerNode:
         self.channel_armed = False
 
     def _send_encoded(self, op: str, payload: Any, hub_id: int = 0) -> None:
-        payload = self.codec.encode(
-            payload, stream=f"w{self.worker_id}>h{hub_id}"
-        )
+        try:
+            payload = self.codec.encode(
+                payload, stream=f"w{self.worker_id}>h{hub_id}"
+            )
+        except ValueError:
+            from omldm_tpu.guard import payload_non_finite
+
+            guard = getattr(self.pipeline, "guard", None)
+            if guard is None or not payload_non_finite(payload):
+                # unguarded — or the payload is actually finite, so this
+                # is some OTHER codec failure: a non-finite leaf at the
+                # ship boundary is a bug upstream and anything else is a
+                # codec defect — both must fail loudly (ops/codec int8
+                # contract), never be swallowed behind the guard
+                raise
+            # guarded + genuinely corrupt payload: this is the state the
+            # guard exists for, caught at a sync point before its next
+            # tick. Suppress the ship — hub admission would reject the
+            # payload anyway — and leave recovery to the pending health
+            # check (rollback + resync).
+            return
         self._send_raw(op, payload, hub_id)
 
     def deliver(self, op: str, payload: Any, hub_id: int = 0) -> None:
@@ -171,6 +190,19 @@ class WorkerNode:
         params register as drift from the stale (init) estimate and fire a
         spurious synchronization."""
 
+    def request_resync(self) -> None:
+        """Ask every hub shard for an authoritative state re-ship. The
+        model-integrity guard fires this right after a last-known-good
+        rollback: the NACK reuses the reliable channel's repair path
+        (Hub._dispatch -> on_nack -> resync_worker -> OP_RESYNC), so the
+        rolled-back worker catches up to the fleet model instead of
+        re-converging from its possibly-stale snapshot. Works on the
+        default exactly-once route too — NACK handling does not require
+        the reliable layer to be armed."""
+        n_hubs = max(int(getattr(self.config, "hub_parallelism", 1)), 1)
+        for h in range(n_hubs):
+            self.send(OP_NACK, {"guard": True}, h)
+
 
 class HubNode:
     """Hub-side protocol node owning global protocol state + statistics."""
@@ -229,6 +261,21 @@ class HubNode:
         self._last_seen: dict = {}
         self._liveness_epoch: Optional[float] = None
         self._retired_live: Set[int] = set()
+        # --- model-integrity delta admission (trainingConfiguration.guard) ---
+        # With the guard armed, every decoded worker payload passes
+        # guard_admit() before protocol logic or round accounting sees it:
+        # non-finite / norm-exploded updates are rejected (deltasRejected),
+        # the sender is resynced with the authoritative model, and after
+        # ``maxStrikes`` rejections it is RETIRED from round accounting
+        # through the same worker_retired/_barrier_recheck machinery the
+        # liveness layer uses — so a poisoned straggler cannot stall a
+        # barrier. A later ADMITTED params push re-admits it (unlike
+        # liveness retirement, any old sign of life is not enough: the
+        # worker must demonstrate a healthy model). Unarmed (default): no
+        # check runs, bit-identical pre-guard dispatch.
+        self.guard_cfg = guard_config(config)
+        self._guard_strikes: dict = {}
+        self._guard_retired: Set[int] = set()
 
     def _reply_ship(self, worker_id: int, op: str, payload: Any) -> None:
         if self.codec is not None:
@@ -254,15 +301,24 @@ class HubNode:
     def liveness_armed(self) -> bool:
         return self.quorum is not None
 
+    def _retired(self) -> Set[int]:
+        """Workers excluded from round accounting: liveness-retired
+        (silent past the deadline) plus guard-retired (repeat poisoned
+        deltas)."""
+        if self._guard_retired:
+            return self._retired_live | self._guard_retired
+        return self._retired_live
+
     def active_workers(self):
-        """Worker ids currently counted by barriers (liveness-retired ids
-        excluded)."""
-        return [w for w in range(self.n_workers) if w not in self._retired_live]
+        """Worker ids currently counted by barriers (liveness- and
+        guard-retired ids excluded)."""
+        retired = self._retired()
+        return [w for w in range(self.n_workers) if w not in retired]
 
     def round_target(self) -> int:
         """Contributions a barrier needs to release: the active worker
-        count (== ``n_workers`` until liveness retires someone)."""
-        return max(self.n_workers - len(self._retired_live), 1)
+        count (== ``n_workers`` until liveness/guard retires someone)."""
+        return max(self.n_workers - len(self._retired()), 1)
 
     def note_worker(self, worker_id: int) -> None:
         """Record a sign of life; re-admit a liveness-retired worker as a
@@ -315,6 +371,85 @@ class HubNode:
         while workers are liveness-retired are quorum releases."""
         if self._retired_live:
             self.stats.update_stats(quorum_releases=1)
+
+    # --- hub-side delta admission (trainingConfiguration.guard) --------------
+
+    @property
+    def guard_armed(self) -> bool:
+        return self.guard_cfg is not None
+
+    def guard_admit(self, worker_id: int, op: str, payload: Any) -> Optional[str]:
+        """Admission check for one decoded worker payload. Returns None
+        (admitted) or the rejection reason — in which case the payload
+        must NOT reach :meth:`receive`: the rejection was counted, the
+        worker resynced with the authoritative model, and (past the strike
+        budget) retired from round accounting so barriers release without
+        it."""
+        reason = admission_reason(payload, self.guard_cfg.norm_limit)
+        if reason is None:
+            if worker_id in self._guard_retired and self._carries_params(
+                payload
+            ):
+                # a healthy params-carrying push is the re-admission
+                # ticket: the worker rejoins round accounting as a fresh
+                # join and is caught up like one (liveness re-admission
+                # semantics; a mere control message is not enough — GM's
+                # violation votes carry no model to judge health by)
+                self._guard_retired.discard(worker_id)
+                self._guard_strikes.pop(worker_id, None)
+                self.resync_worker(worker_id)
+            elif worker_id in self._guard_strikes and self._carries_params(
+                payload
+            ):
+                self._guard_strikes.pop(worker_id, None)
+            return None
+        self.stats.update_stats(deltas_rejected=1)
+        strikes = self._guard_strikes.get(worker_id, 0) + 1
+        self._guard_strikes[worker_id] = strikes
+        if (
+            strikes >= self.guard_cfg.max_strikes
+            and worker_id not in self._guard_retired
+            # same floor the liveness retirement enforces: never take the
+            # active set below the configured quorum (or below one active
+            # worker when no quorum is set)
+            and self.round_target() > max(self.quorum or 1, 1)
+        ):
+            # blast-radius containment: the offender stops being waited
+            # for (its queued barrier entries prune, barriers re-check)
+            # but keeps receiving broadcasts, so a healed model can
+            # re-admit it on a later healthy push
+            self._guard_retired.add(worker_id)
+            self.worker_retired(worker_id)
+            self._barrier_recheck()
+        if self.codec is not None:
+            # a rejected topk delta already ADVANCED our rx base with the
+            # poison (decode runs before admission): drop the base and
+            # NACK the sender so both ends re-anchor — otherwise every
+            # healthy delta from this worker keeps decoding against the
+            # poisoned base (and keeps being rejected) until the next
+            # anchor cycle, up to anchorEvery messages away. Same repair
+            # the gap-detection path uses (runtime/hub.py). FIRST strike
+            # only: the NACK makes the worker re-push synchronously, and
+            # a worker whose own state is still corrupt would otherwise
+            # recurse reject->NACK->re-push without bound.
+            self.codec.reset_rx_stream(f"w{worker_id}>h{self.hub_id}")
+            if strikes == 1:
+                self.nack_worker(worker_id)
+        # authoritative catch-up: the sender's model (or its channel) is
+        # poisoned; ship it the last good global so its local rollback
+        # converges to the fleet instead of a stale snapshot
+        self.resync_worker(worker_id)
+        return reason
+
+    @staticmethod
+    def _carries_params(payload: Any) -> bool:
+        """Whether the payload ships a model vector the admission check
+        actually JUDGED (same criterion as guard._payload_vector) — the
+        re-admission ticket must be a demonstrably healthy model, not any
+        array-shaped payload."""
+        from omldm_tpu.guard import _payload_vector
+
+        return _payload_vector(payload) is not None
 
     def resync_payload(self) -> Optional[dict]:
         """The hub's authoritative state for a catch-up re-ship (``params``
@@ -401,6 +536,9 @@ class HubNode:
         # liveness bookkeeping follows the shrink: retired slots vanish
         self._prune_retired(self._last_seen, n_workers)
         self._retired_live = {w for w in self._retired_live if w < n_workers}
+        # guard bookkeeping too: a reused slot starts with a clean record
+        self._prune_retired(self._guard_strikes, n_workers)
+        self._guard_retired = {w for w in self._guard_retired if w < n_workers}
         # a worker slot reused after shrink-absorb starts fresh streams:
         # the codec must not decode (or delta-encode) against a dead
         # worker's stale bases (receive-side bases included)

@@ -56,7 +56,12 @@ import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 
-from omldm_tpu.pipelines.pipeline import _LRU_CAP, _LRUCache, _build_impls
+from omldm_tpu.pipelines.pipeline import (
+    _LRU_CAP,
+    _LRUCache,
+    _build_impls,
+    _param_health,
+)
 
 # staged batches per member before a launch is forced: bounds the gang input
 # tensor [capacity, T, B, D] when a pipeline has no sync point for a while
@@ -76,12 +81,18 @@ def _tree_map(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
-def _build_gang_programs(learner, preps, per_record: bool, use_vmap: bool):
+def _build_gang_programs(
+    learner, preps, per_record: bool, use_vmap: bool, guarded: bool = False
+):
     """The (fit, shared-input fit, predict, flat) jitted programs for a
     cohort spec.
 
     The member computation is the SAME ``fit_impl`` the per-pipeline path
-    jits; only the iteration over members differs (lax.map or vmap)."""
+    jits; only the iteration over members differs (lax.map or vmap).
+    ``guarded`` cohorts additionally reduce each member's post-scan
+    parameter health (isfinite + squared norm) inside the SAME launch —
+    the per-member half of the model-integrity guard, detecting one
+    diverging member without extra dispatches or perturbing siblings."""
     fit_impl, predict_impl, _eval_impl, _ = _build_impls(
         learner, preps, per_record
     )
@@ -99,7 +110,10 @@ def _build_gang_programs(learner, preps, per_record: bool, use_vmap: bool):
             )
             return new_st, loss
 
-        return jax.lax.scan(step, st, (xs_m, ys_m, ms_m))
+        st2, losses = jax.lax.scan(step, st, (xs_m, ys_m, ms_m))
+        if guarded:
+            return st2, (losses, _param_health(st2["params"]))
+        return st2, losses
 
     def _ravel(p):
         return jax.flatten_util.ravel_pytree(p)[0]
@@ -209,11 +223,14 @@ class Cohort:
         self.key = pipeline.cache_key
         self.use_vmap = use_vmap
         self.timer = timer
+        # guarded pipelines gang with guarded programs (the guard flag is
+        # part of cache_key, so a cohort is uniformly guarded or not)
+        self.guarded = pipeline.guard is not None
         programs = _GANG_CACHE.get((self.key, use_vmap))
         if programs is None:
             programs = _build_gang_programs(
                 pipeline.learner, pipeline.preps, pipeline.per_record,
-                use_vmap,
+                use_vmap, guarded=self.guarded,
             )
             _GANG_CACHE.put((self.key, use_vmap), programs)
         self._gfit, self._gfit_shared, self._gpred, self._gflat = programs
@@ -503,6 +520,8 @@ class Cohort:
                     self.stacked, active, xs, ys, ms
                 )
             self._buf_m[lead, :t_pad] = 0.0
+            if self.guarded:
+                losses = self._note_health(losses, counts)
         else:
             xs = self._buf_x[:, :t_pad]
             ys = self._buf_y[:, :t_pad]
@@ -515,9 +534,27 @@ class Cohort:
             # already zero, and stale x/y rows under a zero mask are inert
             for slot, n in counts.items():
                 self._buf_m[slot, :n] = 0.0
+            if self.guarded:
+                losses = self._note_health(losses, counts)
         if result is not None:
             result.fulfill(losses)
         self._flat_cache = None
+
+    def _note_health(self, gang_out, counts):
+        """Split a guarded gang launch's ``(losses, sq_norm[C])`` output:
+        hand each launched member its health scalar and return the plain
+        loss matrix for the launch result. The [C] health vector is
+        materialized ONCE here (the launch just ran, so this is one small
+        transfer) — per-slot lazy device slices would cost every member
+        its own blocking transfer at the next guard tick, C tiny syncs in
+        exactly the dispatch-overhead regime cohorts exist to collapse."""
+        losses, sq_norm = gang_out
+        vals = np.asarray(sq_norm)
+        for slot, n in counts.items():
+            member = self.members[slot]
+            if member is not None and member.guard is not None:
+                member.guard.note(float(vals[slot]), fits=n)
+        return losses
 
     def _apply_host_writes(self) -> None:
         """Scatter host-side authoritative state (checkouts, written flat

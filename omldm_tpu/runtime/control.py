@@ -105,11 +105,17 @@ class PipelineManager:
         broadcast to workers."""
         if self.validate(request) is not None:
             return False
+        self.apply(request)
+        return True
+
+    def apply(self, request: Request) -> None:
+        """Bookkeeping for an ALREADY-validated request (callers that ran
+        :meth:`validate` themselves — e.g. to quarantine the rejection
+        reason — use this instead of re-validating through admit)."""
         if request.request in (RequestType.CREATE, RequestType.UPDATE):
             self.node_map[request.id] = request
         elif request.request == RequestType.DELETE:
             del self.node_map[request.id]
-        return True
 
     def query_targets(self, request: Request, parallelism: int) -> List[int]:
         """Worker ids a Query goes to: worker 0 only for single-learner

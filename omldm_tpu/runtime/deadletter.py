@@ -1,0 +1,135 @@
+"""Dead-letter sink: quarantine for malformed / rejected stream input.
+
+Reference counterpart: the reference SILENTLY drops anything its parsers
+reject — ``DataInstanceParser`` swallows parse errors and ``isValid``
+failures (DataPointParser.scala:13-21, DataInstanceDeserializer.scala:24-33)
+and ``PipelineMap`` prints-and-drops invalid requests
+(PipelineMap.scala:34,46). At "millions of users" scale a silent drop is
+indistinguishable from data loss, so the TPU runtime routes every rejected
+record/request here instead, tagged with a machine-readable REASON CODE
+(see ``DataInstance.invalid_reason`` / ``DataInstance.parse`` for the
+record codes, plus ``malformed_request`` / ``rejected_request`` on the
+control stream).
+
+The sink always keeps a bounded in-memory ring (tests and live debugging
+read it); ``path`` adds an append-only JSONL file (one
+``{"stream", "reason", "detail"?, "payload"}`` object per line); and
+``publish`` — wired by the Kafka CLI route to
+``ProducerSinks.on_dead_letter`` — forwards each entry to a ``deadLetters``
+topic. Quarantine NEVER raises: a failing dead-letter file must not take
+down the stream it exists to protect.
+
+Scope: the per-record JSON event route (``StreamJob.process_event`` — the
+Kafka route included, which is the boundary that faces hostile producers).
+The packed/fused bulk-ingest routes parse in native code against trusted
+local files and keep the reference's silent drop there; their keep/drop
+decisions are pinned byte-equivalent to the Python codec by
+``tests/test_parser_fuzz.py``, so nothing diverges — it is only not
+*recorded* on those routes.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import sys
+from typing import Any, Callable, Deque, Dict, Optional
+
+# cap on the raw payload text preserved per entry: quarantine exists for
+# diagnosis, not archival — a hostile 100 MB line must not be amplified
+MAX_PAYLOAD_CHARS = 4096
+
+
+class DeadLetterSink:
+    """Bounded quarantine for rejected stream input, with reason codes."""
+
+    def __init__(
+        self,
+        path: str = "",
+        cap: int = 10_000,
+        publish: Optional[Callable[[dict], None]] = None,
+        request_stream: str = "requests",
+    ):
+        self.path = path or ""
+        self.entries: Deque[dict] = collections.deque(maxlen=max(int(cap), 1))
+        #: optional external publisher (e.g. a Kafka deadLetters topic)
+        self.publish = publish
+        #: stream name whose entries count as requests, not records (the
+        #: job passes its REQUEST_STREAM constant so the record/request
+        #: split cannot drift from the routing layer's naming)
+        self._request_stream = request_stream
+        self.record_count = 0
+        self.request_count = 0
+        self.by_reason: Dict[str, int] = {}
+        self._fh = None
+        self._file_failed = False
+
+    def quarantine(
+        self,
+        stream: str,
+        payload: Any,
+        reason: str,
+        detail: Optional[str] = None,
+    ) -> dict:
+        """Record one rejected input. Returns the entry (for callers that
+        log or publish it further). Never raises."""
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8", errors="replace")
+        elif not isinstance(payload, str):
+            try:
+                payload = json.dumps(payload, default=str)
+            except (TypeError, ValueError):
+                payload = str(payload)
+        entry = {
+            "stream": stream,
+            "reason": reason,
+            "payload": payload[:MAX_PAYLOAD_CHARS],
+        }
+        if detail:
+            entry["detail"] = detail
+        self.entries.append(entry)
+        if stream == self._request_stream:
+            self.request_count += 1
+        else:
+            self.record_count += 1
+        self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
+        self._write(entry)
+        if self.publish is not None:
+            try:
+                self.publish(entry)
+            except Exception as exc:  # a dead topic must not kill the job
+                print(
+                    f"warning: dead-letter publish failed: {exc}",
+                    file=sys.stderr,
+                )
+                self.publish = None
+        return entry
+
+    @property
+    def total(self) -> int:
+        return self.record_count + self.request_count
+
+    def _write(self, entry: dict) -> None:
+        if not self.path or self._file_failed:
+            return
+        try:
+            if self._fh is None:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+        except OSError as exc:
+            # degrade to in-memory only, once, loudly
+            self._file_failed = True
+            print(
+                f"warning: dead-letter file {self.path!r} unwritable "
+                f"({exc}); quarantine continues in memory only",
+                file=sys.stderr,
+            )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None

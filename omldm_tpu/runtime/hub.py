@@ -142,6 +142,14 @@ class Hub:
             return
         if self.node.codec is not None:
             payload = self.node.codec.decode(payload)
+        # model-integrity delta admission (trainingConfiguration.guard):
+        # a non-finite or norm-exploding worker update is rejected HERE,
+        # after decode but before protocol logic or round accounting can
+        # fold it into shared state; guard_admit resyncs (and eventually
+        # retires) the offender. Unarmed (default): one attribute read.
+        if self.node.guard_armed:
+            if self.node.guard_admit(worker_id, op, payload) is not None:
+                return
         self.node.receive(worker_id, op, payload)
 
     def flush_windows(self) -> None:

@@ -26,6 +26,7 @@ from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
 from omldm_tpu.api.stats import JobStatistics
 from omldm_tpu.config import JobConfig
 from omldm_tpu.runtime.control import PipelineManager
+from omldm_tpu.runtime.deadletter import DeadLetterSink
 from omldm_tpu.runtime.hub import HubManager
 from omldm_tpu.runtime.messages import channel_chaos_spec
 from omldm_tpu.runtime.responses import ResponseMerger
@@ -64,6 +65,14 @@ class StreamJob:
 
         self.pipeline_manager = PipelineManager()
         self.stats = StatisticsCollector(self.config, self._emit_performance)
+        # dead-letter quarantine: malformed / validation-rejected records
+        # and requests land here with reason codes instead of vanishing
+        # (the reference drops them silently, DataPointParser.scala:13-21)
+        self.dead_letter = DeadLetterSink(
+            path=self.config.dead_letter_path,
+            cap=self.config.dead_letter_cap,
+            request_stream=REQUEST_STREAM,
+        )
         self.response_merger = ResponseMerger(self._emit_response)
         self.hub_manager = HubManager(self.config, self._ship_to_spoke)
         # deterministic chaos channel on the in-process hub<->spoke bridge
@@ -247,17 +256,25 @@ class StreamJob:
     def _process_event_inner(self, stream: str, payload: Any) -> None:
         self.events_processed += 1
         if stream == REQUEST_STREAM:
-            request = (
-                payload if isinstance(payload, Request) else Request.from_json(payload)
-            )
+            if isinstance(payload, Request):
+                request = payload
+            else:
+                request = Request.from_json(payload)
+                if request is None:
+                    self.dead_letter.quarantine(
+                        stream, payload, "malformed_request"
+                    )
             if request is not None:
                 self._handle_request(request)
         elif stream in (TRAINING_STREAM, FORECASTING_STREAM):
-            inst = (
-                payload
-                if isinstance(payload, DataInstance)
-                else DataInstance.from_json(payload)
-            )
+            if isinstance(payload, DataInstance):
+                inst = payload
+            else:
+                inst, reason = DataInstance.parse(payload)
+                if reason is not None:
+                    # EOS markers / blank lines return (None, None) and
+                    # pass through silently — they are protocol, not poison
+                    self.dead_letter.quarantine(stream, payload, reason)
             if inst is not None:
                 if stream == FORECASTING_STREAM:
                     inst.operation = FORECASTING
@@ -267,8 +284,16 @@ class StreamJob:
 
     def _handle_request(self, request: Request) -> None:
         self.stats.mark_activity()
-        if not self.pipeline_manager.admit(request):
+        err = self.pipeline_manager.validate(request)
+        if err is not None:
+            # the reference println-and-drops (PipelineMap.scala:34,46);
+            # here the rejection is quarantined with its validation error
+            self.dead_letter.quarantine(
+                REQUEST_STREAM, request.to_json(), "rejected_request",
+                detail=err,
+            )
             return
+        self.pipeline_manager.apply(request)
         if request.request in (RequestType.CREATE, RequestType.UPDATE):
             dim = self._request_dim(request)
             if dim is None:
@@ -468,6 +493,10 @@ class StreamJob:
                     dst.node.on_model_seeded()
                     if dst.node.codec is not None:
                         dst.node.codec.reset_streams()
+                    # guard LKG snapshots restart at the seeded model: a
+                    # rollback must never land on the stale init params
+                    if dst.pipeline.guard is not None:
+                        dst.pipeline.guard.reseed(dst.pipeline)
         else:
             survivors, retired = self.spokes[:n_new], self.spokes[n_new:]
             self.config.parallelism = n_new
@@ -652,15 +681,22 @@ class StreamJob:
         self.stats.probe_fired = True
         for spoke in self.spokes:
             spoke.handle_terminate_probe()
+        # quarantined-record count, mirrored into every pipeline's report
+        # (a dropped record would have reached each of them; see the
+        # Statistics.records_quarantined field note)
+        nq = self.dead_letter.record_count
         for bridge in self.spmd_bridges.values():
             bridge.handle_terminate_probe()
-            self.stats.add_hub_statistics(
-                bridge.request.id, bridge.network_statistics()
-            )
+            bridge_stats = bridge.network_statistics()
+            if nq and bridge_stats is not None:
+                bridge_stats.update_stats(records_quarantined=nq)
+            self.stats.add_hub_statistics(bridge.request.id, bridge_stats)
         self.hub_manager.on_terminate()
         for net_id in self.pipeline_manager.live_pipelines:
             merged = self.hub_manager.network_statistics(net_id)
             if merged is not None:
+                if nq:
+                    merged.update_stats(records_quarantined=nq)
                 merged.normalize(
                     max(
                         len(
@@ -674,4 +710,10 @@ class StreamJob:
                     )
                 )
                 self.stats.add_hub_statistics(net_id, merged)
-        return self.stats.try_finalize(len(self.pipeline_manager.live_pipelines))
+        report = self.stats.try_finalize(
+            len(self.pipeline_manager.live_pipelines)
+        )
+        # release the dead-letter file handle (supervised restarts open a
+        # fresh one per incarnation; a late quarantine reopens on demand)
+        self.dead_letter.close()
+        return report

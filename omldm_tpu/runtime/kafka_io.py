@@ -45,6 +45,9 @@ DEFAULT_OUT_TOPICS = {
     "predictions": "predictions",
     "responses": "responses",
     "performance": "performance",
+    # quarantined records/requests with reason codes (runtime.deadletter);
+    # no reference counterpart — the reference drops them silently
+    "deadLetters": "deadLetters",
 }
 
 
@@ -209,6 +212,13 @@ class ProducerSinks:
 
     def on_performance(self, report) -> None:
         self._send("performance", report)
+
+    def on_dead_letter(self, entry: dict) -> None:
+        """Publish one quarantined record/request (a plain dict entry from
+        :class:`~omldm_tpu.runtime.deadletter.DeadLetterSink`). Same
+        degrade-on-failure semantics as every other sink — the quarantine
+        ring and file keep the entry either way."""
+        self._send("deadLetters", entry)
 
 
 def _partitions_with_retry(consumer, topic, retry: Optional[BackoffPolicy] = None):
@@ -388,7 +398,15 @@ def connect_kafka(
     # Unarmed (the default) this returns the consumer untouched.
     from omldm_tpu.runtime.supervisor import maybe_chaos_consumer
 
-    chaos_consumer = maybe_chaos_consumer(consumer)
+    chaos_consumer = maybe_chaos_consumer(
+        consumer,
+        # the CONTROL stream is exempt from poison-record injection: a
+        # poisoned request is consumed (offset advances, no replay) and
+        # its loss would silently change the job topology
+        poison_exempt_topics=[
+            t for t, s in topic_map.items() if s == REQUEST_STREAM
+        ],
+    )
     return (
         polling_events(chaos_consumer, topic_map, tracker=tracker),
         ProducerSinks(

@@ -28,6 +28,7 @@ from omldm_tpu.api.data import FORECASTING, DataInstance, Prediction
 from omldm_tpu.api.requests import Request, RequestType
 from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
 from omldm_tpu.config import JobConfig
+from omldm_tpu.guard import guard_config
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.protocols.base import WorkerNode
 from omldm_tpu.protocols.registry import make_worker_node, resolve_protocol
@@ -42,6 +43,7 @@ from omldm_tpu.runtime.messages import (
     reliability_armed,
 )
 from omldm_tpu.runtime.vectorizer import (
+    F32_MAX,
     MicroBatcher,
     SparseMicroBatcher,
     SparseVectorizer,
@@ -159,6 +161,10 @@ class SpokeNet:
             dim=dim,
             rng=jax.random.PRNGKey(request.id),
             per_record=tc.per_record,
+            # model-integrity guard (trainingConfiguration.guard): fused
+            # in-program health checks + the LKG rollback ring; None
+            # (default) keeps the exact pre-guard programs
+            guard=guard_config(tc),
         )
         self.node = make_worker_node(
             self.protocol, pipeline, worker_id, n_workers, tc, send
@@ -294,6 +300,10 @@ class Spoke:
         # resynced) fold into the pipeline's hub statistics through this
         # job-provided callback: (network_id, hub_id, counter_name, n)
         self._note_wire = note_wire
+        # model-integrity guard: True once any hosted net is guard-armed;
+        # the per-event guard walk is gated on this one flag so unarmed
+        # jobs pay a single attribute read on the data path
+        self._any_guard = False
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
@@ -328,6 +338,12 @@ class Spoke:
         )
         self.nets[request.id] = net
         net.node.on_start()
+        if net.pipeline.guard is not None:
+            self._any_guard = True
+            # seed the first last-known-good snapshot at the init params:
+            # a trip before the first cadence snapshot must still have a
+            # rollback target
+            net.pipeline.guard.maybe_snapshot(net.pipeline)
         if self.cohorts is not None:
             self.cohorts.consider(net.pipeline)
             # pooled pipelines may attach on a LATER create (auto
@@ -404,6 +420,8 @@ class Spoke:
             self._serve_many(inst, serve_entries)
         # gang barrier: launch every cohort's staged fits for this record
         self._flush_cohorts()
+        # guard: evaluate the health results this record's launches noted
+        self._guard_tick_all()
         if inst.operation != FORECASTING:
             # poll marker every 100 training records — once per record, not
             # per hosted pipeline (FlinkSpoke.scala:83-89)
@@ -455,6 +473,7 @@ class Spoke:
         elif gang_nets:
             self._process_packed_gang(gang_nets, x, y, f_idx)
         self._flush_cohorts()
+        self._guard_tick_all()
         nt = n - int(f_idx.size)
         if nt:
             pc = self._poll_counter
@@ -579,6 +598,12 @@ class Spoke:
                 )
 
     def _train(self, net: SpokeNet, x, y: float) -> None:
+        # float32 boundary clamp for the target, matching the packed/C
+        # ingest routes (vectorizer.clamp_f32 covers the features): a
+        # finite-double target beyond float32 range would otherwise
+        # overflow to inf in the batcher and poison the model through a
+        # record the validation boundary admitted
+        y = min(max(float(y), -F32_MAX), F32_MAX)
         # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
         c = net.holdout_count % 10
         net.holdout_count += 1
@@ -626,6 +651,10 @@ class Spoke:
         buckets and averages metrics across workers."""
         net.flush_batch()
         self._flush_cohorts()
+        # settle any pending guard trip BEFORE evaluating: a query must
+        # never report a NaN score off corrupt params the guard was about
+        # to roll back
+        self._guard_tick_all()
         test = net.test_arrays()
         if test is not None:
             loss, score = net.pipeline.evaluate(*test)
@@ -775,6 +804,60 @@ class Spoke:
     def _flush_cohorts(self) -> None:
         if self.cohorts is not None:
             self.cohorts.flush()
+
+    # --- model-integrity guard (omldm_tpu.guard) -------------------------
+
+    def _guard_tick_all(self) -> None:
+        """Evaluate every guarded net's pending in-program health results
+        (noted by the fit launches since the last tick) and run the
+        recovery ladder for any that tripped. One flag read when no hosted
+        net is guard-armed."""
+        if not self._any_guard:
+            return
+        for net in list(self.nets.values()):
+            guard = net.pipeline.guard
+            if guard is None:
+                continue
+            reason = guard.check()
+            if reason is None:
+                guard.maybe_snapshot(net.pipeline)
+            else:
+                self._guard_trip(net, reason)
+
+    def _guard_trip(self, net: SpokeNet, reason: str) -> None:
+        """Divergence detected on one net: contain, roll back, resync.
+
+        - cohort members EVICT to solo execution first (Cohort.detach:
+          state materializes out of the stacked tree, the slot frees, no
+          recompile, siblings bitwise untouched) so the corrupt state and
+          its recovery churn never ride another tenant's gang launch;
+        - parameters roll back to the last-known-good snapshot;
+        - the worker asks its hub shards for an authoritative resync
+          (OP_NACK -> OP_RESYNC), catching up to the fleet model where one
+          exists instead of re-converging from the snapshot alone."""
+        nid = net.request.id
+        if net.pipeline._cohort is not None and self.cohorts is not None:
+            self.cohorts.retire(net.pipeline)
+            if self._note_wire is not None:
+                self._note_wire(nid, 0, "members_evicted", 1)
+        net.pipeline.guard.rollback(net.pipeline)
+        if self._note_wire is not None:
+            self._note_wire(nid, 0, "rollbacks_performed", 1)
+        if net.node.codec is not None:
+            # the rollback replaced the model wholesale AND corrupt state
+            # may already have shipped: EF residuals and topk tx bases are
+            # stale/poisoned on both ends (same treatment as the rescale
+            # merge path)
+            net.node.codec.reset_streams()
+        net.node.request_resync()
+        if getattr(net.node, "waiting", False):
+            # a blocking worker whose poisoned push was suppressed or
+            # rejected may be mid-barrier with nothing in flight — and if
+            # the hub holds no authoritative state yet, the resync above
+            # ships nothing back. Re-push the now-healthy state so the
+            # round can complete (idempotent: barrier entries are
+            # worker-keyed — the same repair on_stall performs).
+            net.node.resend_state()
 
     def _process_packed_gang(self, nets, x, y, f_idx) -> None:
         """Lockstep twin of ``_process_packed_for_net`` over ALL nets:
@@ -1003,6 +1086,8 @@ class Spoke:
                 # a job-managed rescale): adopt the retiring replica whole
                 rnet.shared_taint = True
                 self.nets[net_id] = rnet
+                if rnet.pipeline.guard is not None:
+                    self._any_guard = True
                 continue
             snet.shared_taint = True
             # pending rows train into the surviving replica: the batcher's
@@ -1039,6 +1124,10 @@ class Spoke:
             # topk bases computed against the pre-merge model are stale
             if snet.node.codec is not None:
                 snet.node.codec.reset_streams()
+            # ... and so are last-known-good snapshots: a guard rollback
+            # must not undo the absorbed replica's contribution
+            if snet.pipeline.guard is not None:
+                snet.pipeline.guard.reseed(snet.pipeline)
             # holdout windows interleave (keep-newest overflow), the same
             # merge the reference's rescale uses (CommonUtils.scala:36-48)
             snet.test_set.merge([rnet.test_set])

@@ -508,6 +508,15 @@ class DistributedFaultInjector:
 # envelopes instead of hoping.
 
 _CHAOS_PARAMS = ("drop", "dup", "reorder", "delay")
+# corruption (poison) fault classes — distinct from the loss classes
+# above: the message ARRIVES, but its content is hostile. ``nan`` plants a
+# NaN in a shipped parameter vector, ``explode`` scales it past any sane
+# norm, ``poison`` (record streams only) mutates a source record into
+# malformed/non-finite input. These drive the model-integrity guard's
+# detection/rollback/quarantine paths the way drop/dup drive the reliable
+# channel. Probability draws happen ONLY when a corruption class is armed,
+# so pre-existing specs keep their exact seeded schedules.
+_CHAOS_CORRUPT = ("nan", "explode", "poison")
 
 
 def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
@@ -515,7 +524,8 @@ def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
     {...}}``.
 
     Format: comma-separated ``key=value`` pairs. ``seed`` and ``window``
-    are channel-wide; ``drop``/``dup``/``reorder``/``delay`` are
+    are channel-wide; ``drop``/``dup``/``reorder``/``delay`` (loss
+    classes) and ``nan``/``explode``/``poison`` (corruption classes) are
     probabilities applied to BOTH directions unless prefixed
     (``up.drop=0.1`` hits only worker->hub, ``down.dup=0.05`` only
     hub->worker). Returns None for an empty/None spec; raises ValueError
@@ -523,7 +533,7 @@ def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
     fault-free."""
     if not spec:
         return None
-    base = {k: 0.0 for k in _CHAOS_PARAMS}
+    base = {k: 0.0 for k in _CHAOS_PARAMS + _CHAOS_CORRUPT}
     out: Dict = {"seed": 0, "window": 4, "up": dict(base), "down": dict(base)}
     for part in str(spec).split(","):
         part = part.strip()
@@ -536,14 +546,122 @@ def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
             out[key] = int(float(value))
         elif "." in key:
             direction, _, param = key.partition(".")
-            if direction not in ("up", "down") or param not in _CHAOS_PARAMS:
+            if direction not in ("up", "down") or param not in (
+                _CHAOS_PARAMS + _CHAOS_CORRUPT
+            ):
                 raise ValueError(f"unknown chaos key {key!r}")
             out[direction][param] = float(value)
-        elif key in _CHAOS_PARAMS:
+        elif key in _CHAOS_PARAMS + _CHAOS_CORRUPT:
             out["up"][key] = out["down"][key] = float(value)
         else:
             raise ValueError(f"unknown chaos key {key!r}")
     return out
+
+
+def _corrupt_payload(payload, mode: str, rng):
+    """A corrupted COPY of a protocol payload, or None when the payload
+    carries nothing corruptible (control votes, NACKs, raw-data forwards —
+    corrupting those would test the wrong layer). ``nan`` plants a NaN at
+    a seeded position of the shipped parameter vector; ``explode`` scales
+    the vector by 1e12, far past any configured guard norm limit.
+    Codec-encoded params (``EncodedLeaf``) corrupt too — the on-wire form
+    is exactly what a real fault would hit, and skipping it would make
+    ``nan``/``explode`` silently inert on codec-armed pipelines. The
+    original payload object is never mutated (the sender may hold
+    references)."""
+    import numpy as np
+
+    def corrupt_vec(vec):
+        vec = vec.copy()
+        flat = vec.ravel()
+        if mode == "nan":
+            flat[int(rng.randint(flat.size))] = np.nan
+        else:  # explode
+            flat *= np.float32(1e12)
+        return vec
+
+    def corrupt_leaf(leaf):
+        from omldm_tpu.runtime.codec import EncodedLeaf
+
+        if leaf.kind == "fp16":
+            data = leaf.data.copy()
+            if mode == "nan":
+                data.ravel()[int(rng.randint(data.size))] = np.float16(np.nan)
+            else:  # fp16 max is 65504: a big scale overflows to inf
+                data = data * np.float16(1e4) * np.float16(1e4)
+            meta = leaf.meta
+        elif leaf.kind == "int8":
+            # uint8 codes can't hold a NaN; corrupt the affine meta so the
+            # DECODE goes non-finite/exploded — the receiver-side shape of
+            # the same fault
+            data = leaf.data
+            scale, zero = leaf.meta
+            meta = (
+                (np.float32(np.nan), zero) if mode == "nan"
+                else (np.float32(1e12), zero)
+            )
+        elif leaf.kind == "topk":
+            idx, val = leaf.data
+            if val.size == 0:
+                return None
+            data = (idx, corrupt_vec(val))
+            meta = leaf.meta
+        else:
+            return None
+        return EncodedLeaf(
+            leaf.kind, data, meta, leaf.shape, leaf.dtype, leaf.stream,
+            leaf.seq,
+        )
+
+    def corrupt_any(value):
+        if (
+            isinstance(value, np.ndarray)
+            and value.dtype.kind == "f"
+            and value.size
+        ):
+            return corrupt_vec(value)
+        # duck-typed EncodedLeaf (kind/data/meta/shape): avoid importing
+        # the codec module on the fault-free path
+        if hasattr(value, "kind") and hasattr(value, "meta") and hasattr(
+            value, "stream"
+        ):
+            return corrupt_leaf(value)
+        return None
+
+    corrupted = corrupt_any(payload)
+    if corrupted is not None:
+        return corrupted
+    if isinstance(payload, dict):
+        params = corrupt_any(payload.get("params"))
+        if params is not None:
+            out = dict(payload)
+            out["params"] = params
+            return out
+    return None
+
+
+# poisoned-record templates the record-stream injector rotates through:
+# a bare-NaN feature (json.loads accepts the literal the reference's
+# Jackson rejects), an overflow-to-inf feature, a non-finite target, and
+# structurally-malformed JSON — one per guard/quarantine rejection class
+_POISON_RECORDS = (
+    '{"numericalFeatures": [NaN, 1.0], "target": 1.0}',
+    '{"numericalFeatures": [1e999, 0.5], "target": 0.0}',
+    '{"numericalFeatures": [1.0, 2.0], "target": Infinity}',
+    '{"numericalFeatures": [1.0, 2.0], "target": ',
+)
+
+
+class _PoisonedRecord:
+    """Minimal ConsumerRecord stand-in carrying a poisoned value."""
+
+    __slots__ = ("topic", "value", "partition", "offset")
+
+    def __init__(self, rec, value):
+        self.topic = rec.topic
+        self.value = value
+        self.partition = getattr(rec, "partition", 0)
+        self.offset = getattr(rec, "offset", None)
 
 
 def _chaos_rng(seed: int, name: str):
@@ -580,6 +698,9 @@ class ChaosChannel:
         dup: float = 0.0,
         reorder: float = 0.0,
         delay: float = 0.0,
+        nan: float = 0.0,
+        explode: float = 0.0,
+        poison: float = 0.0,  # record-stream class; inert on the bridge
         window: int = 4,
         name: str = "chan",
     ):
@@ -589,6 +710,13 @@ class ChaosChannel:
         self.dup = float(dup)
         self.reorder = float(reorder)
         self.delay = float(delay)
+        # payload-corruption injectors (model-integrity guard drivers):
+        # the message still arrives, but its parameter vector carries a
+        # seeded NaN or a 1e12 norm explosion. Fate draws happen ONLY when
+        # a corruption class is armed, so loss-only specs keep their exact
+        # pre-existing seeded schedules.
+        self.nan = float(nan)
+        self.explode = float(explode)
         self.window = max(int(window), 1)
         self.name = name
         self.active = True
@@ -598,6 +726,7 @@ class ChaosChannel:
         self.dropped = 0
         self.duplicated = 0
         self.reordered = 0
+        self.corrupted = 0
 
     @classmethod
     def from_spec(cls, deliver, spec: Dict, direction: str, name: str = ""):
@@ -615,6 +744,20 @@ class ChaosChannel:
             self.delivered += 1
             self._deliver(*args)
             return
+        if self.nan > 0.0 or self.explode > 0.0:
+            # (net, hub, worker, op, payload, seq) on both directions:
+            # payload rides at index 4
+            u_nan, u_explode = self._rng.random_sample(2)
+            mode = (
+                "nan" if u_nan < self.nan
+                else "explode" if u_explode < self.explode
+                else None
+            )
+            if mode is not None and len(args) > 4:
+                corrupted = _corrupt_payload(args[4], mode, self._rng)
+                if corrupted is not None:
+                    args = args[:4] + (corrupted,) + args[5:]
+                    self.corrupted += 1
         u_drop, u_dup, u_reorder, u_delay = self._rng.random_sample(4)
         if u_drop < self.drop:
             self.dropped += 1
@@ -664,6 +807,7 @@ class ChaosChannel:
             "dropped": self.dropped,
             "duplicated": self.duplicated,
             "reordered": self.reordered,
+            "corrupted": self.corrupted,
         }
 
 
@@ -680,17 +824,36 @@ class ChaosConsumer:
 
     def __init__(self, inner, *, seed: int = 0, drop: float = 0.0,
                  dup: float = 0.0, reorder: float = 0.0, delay: float = 0.0,
-                 window: int = 4, name: str = "kafka"):
+                 poison: float = 0.0, nan: float = 0.0, explode: float = 0.0,
+                 window: int = 4, name: str = "kafka",
+                 poison_exempt_topics=()):
         self._inner = inner
         self._rng = _chaos_rng(seed, name)
         self._drop = float(drop)
         self._dup = float(dup)
         self._reorder = float(reorder + delay)
+        # poison-record injection: with probability ``poison`` a consumed
+        # record's VALUE is replaced by a seeded malformed/non-finite
+        # template (_POISON_RECORDS) — the hostile-producer fault the
+        # dead-letter quarantine + isValid boundary must absorb without
+        # crashing or training on it. ``nan``/``explode`` are channel
+        # (parameter-payload) classes and are inert on a record stream —
+        # accepted so one spec string can arm both layers.
+        self._poison = float(poison)
+        # topics poison must never touch (the CONTROL stream): a poisoned
+        # record is consumed — its offset advances — so unlike the drop
+        # class it is not replayed later. Destroying a Create/Delete
+        # would silently change the job topology forever, which is a
+        # different fault class than hostile data records. The fate draw
+        # still happens for exempt topics so the corruption schedule of
+        # the data streams does not depend on the topic mix.
+        self._poison_exempt = frozenset(poison_exempt_topics)
         self._window = max(int(window), 1)
         self._held: List[list] = []  # [countdown, record]
         self.dropped = 0
         self.duplicated = 0
         self.reordered = 0
+        self.poisoned = 0
 
     def __iter__(self):
         return self
@@ -716,6 +879,16 @@ class ChaosConsumer:
                 raise
             for h in self._held:
                 h[0] -= 1
+            if self._poison > 0.0:
+                u_poison = self._rng.random_sample()
+                hit = u_poison < self._poison
+                if hit:
+                    value = _POISON_RECORDS[
+                        int(self._rng.randint(len(_POISON_RECORDS)))
+                    ]
+                if hit and getattr(rec, "topic", None) not in self._poison_exempt:
+                    rec = _PoisonedRecord(rec, value)
+                    self.poisoned += 1
             u_drop, u_dup, u_reorder = self._rng.random_sample(3)
             if u_dup < self._dup:
                 self._held.append(
@@ -742,10 +915,13 @@ def maybe_chaos_consumer(
     flags: Optional[Dict[str, str]] = None,
     env_var: str = "OMLDM_CHAOS_KAFKA",
     name: str = "kafka",
+    poison_exempt_topics=(),
 ):
     """Wrap ``consumer`` in a :class:`ChaosConsumer` when broker chaos is
     armed (``--kafkaChaos`` flag or the env var, which reaches supervised
-    worker subprocesses); otherwise return it untouched."""
+    worker subprocesses); otherwise return it untouched.
+    ``poison_exempt_topics`` names topics the poison class must never
+    mutate — callers pass their request/control topics."""
     spec_str = (flags or {}).get("kafkaChaos") or os.environ.get(env_var, "")
     spec = parse_chaos_spec(spec_str)
     if spec is None:
@@ -759,7 +935,8 @@ def maybe_chaos_consumer(
         flush=True,
     )
     return ChaosConsumer(
-        consumer, seed=spec["seed"], window=spec["window"], name=name, **params
+        consumer, seed=spec["seed"], window=spec["window"], name=name,
+        poison_exempt_topics=poison_exempt_topics, **params
     )
 
 

@@ -262,3 +262,50 @@ class TestConfigPlumbing:
 
     def test_explicit_none_builds_nothing(self):
         assert make_transport_codec(tc_for("none")) is None
+
+
+class TestInt8DegeneratePaths:
+    """ISSUE 7 satellite: the int8 degenerate-scale path must be a clean
+    passthrough for constant/zero leaves (no spurious error-feedback
+    residual) and must FAIL LOUDLY on non-finite leaves instead of
+    encoding garbage."""
+
+    @pytest.mark.parametrize("value", [0.0, 3.25, -1e-30])
+    def test_constant_leaf_round_trips_exactly(self, value):
+        x = np.full((64,), value, np.float32)
+        q, scale, zero = int8_affine_encode(x)
+        dec = int8_affine_decode(q, scale, zero)
+        np.testing.assert_array_equal(dec, x)  # bitwise, not approximate
+
+    def test_constant_leaf_leaves_zero_residual(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.full((64,), 7.5, np.float32)
+        for _ in range(8):
+            leaf = codec.encode({"params": x}, stream="w0>h0")["params"]
+            dec = decode_payload({"params": leaf})["params"]
+            np.testing.assert_array_equal(dec, x)
+        resid = codec._residual[("w0>h0", ".params")]
+        np.testing.assert_array_equal(resid, np.zeros_like(resid))
+
+    def test_subnormal_span_stays_finite(self):
+        # a span whose /255 underflows must take the passthrough branch,
+        # not divide by zero
+        x = np.full((32,), 1.0, np.float32)
+        x[0] = 1.0 + 1e-45
+        q, scale, zero = int8_affine_encode(x)
+        dec = int8_affine_decode(q, scale, zero)
+        assert np.isfinite(dec).all()
+
+    @pytest.mark.parametrize("bad", [np.nan, np.inf, -np.inf])
+    def test_non_finite_leaf_fails_loudly(self, bad):
+        x = np.ones((64,), np.float32)
+        x[13] = bad
+        with pytest.raises(ValueError, match="non-finite"):
+            int8_affine_encode(x)
+
+    def test_non_finite_leaf_fails_loudly_through_codec(self):
+        codec = TransportCodec("int8", min_leaf_size=4)
+        x = np.ones((64,), np.float32)
+        x[0] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            codec.encode({"params": x}, stream="w0>h0")

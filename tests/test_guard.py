@@ -1,0 +1,974 @@
+"""Model-integrity guard: divergence detection, rollback, containment.
+
+Pins the ISSUE 7 acceptance bars:
+
+- guard UNSET => every route (solo, cohort, codec int8) is bit-identical
+  to the pre-guard path, and arming the guard on a CLEAN stream changes
+  nothing either (the health reductions ride the fit launches without
+  touching the state math);
+- seeded poison (NaN delta, exploding delta, poison record) on all six
+  parameter protocols: the job never crashes, the guard counters engage,
+  and the final holdout score stays within 0.05 of the fault-free run;
+- a cohort member that diverges is EVICTED to solo execution while every
+  healthy sibling's result stays bitwise unchanged;
+- malformed records land in the dead-letter sink with reason codes and
+  never mutate model state.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from omldm_tpu.api.requests import LearnerSpec, TrainingConfiguration
+from omldm_tpu.config import JobConfig
+from omldm_tpu.guard import (
+    GuardConfig,
+    ModelGuard,
+    admission_reason,
+    guard_config,
+)
+from omldm_tpu.pipelines import MLPipeline
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.job import REQUEST_STREAM, TRAINING_STREAM
+
+DIM = 12
+PARAM_PROTOCOLS = ("Asynchronous", "Synchronous", "SSP", "EASGD", "GM", "FGM")
+
+
+def make_stream(records, dim=DIM, seed=11):
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(42).randn(dim)
+    x = rng.randn(records, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+def create_request(pid=0, protocol="Asynchronous", dim=DIM, guard=None,
+                   codec=None, sync_every=2, extra=None):
+    tc = {"protocol": protocol, "syncEvery": sync_every}
+    if guard is not None:
+        tc["guard"] = guard
+    if codec is not None:
+        tc["comm"] = {"codec": codec}
+    tc.update(extra or {})
+    return json.dumps({
+        "id": pid,
+        "request": "Create",
+        "learner": {
+            "name": "PA",
+            "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": dim},
+        },
+        "trainingConfiguration": tc,
+    })
+
+
+def run_job(x, y, requests, parallelism=2, batch=32, chaos="", cohort="off",
+            chunk=512, poke=None):
+    """Drive a packed stream through a StreamJob; ``poke(job)`` runs once
+    between two chunks (mid-stream fault injection)."""
+    job = StreamJob(JobConfig(
+        parallelism=parallelism, batch_size=batch, test_set_size=64,
+        chaos=chaos, cohort=cohort, cohort_min=2,
+    ))
+    for req in requests:
+        job.process_event(REQUEST_STREAM, req)
+    op = np.zeros((x.shape[0],), np.uint8)
+    poke_at = 2 * chunk
+    for i in range(0, x.shape[0], chunk):
+        job.process_packed_batch(x[i:i+chunk], y[i:i+chunk], op[i:i+chunk])
+        if poke is not None and i == poke_at:
+            poke(job)
+            poke = None
+    report = job.terminate()
+    return report, job
+
+
+def nan_poke(spoke_idx=0, net_id=0):
+    def poke(job):
+        net = job.spokes[spoke_idx].nets[net_id]
+        flat, _ = net.pipeline.get_flat_params()
+        net.pipeline.set_flat_params(np.full_like(flat, np.nan))
+    return poke
+
+
+# --- units ------------------------------------------------------------------
+
+
+class TestGuardConfig:
+    def test_unset_is_none(self):
+        assert guard_config(TrainingConfiguration()) is None
+        assert guard_config(
+            TrainingConfiguration(extra={"guard": False})
+        ) is None
+
+    def test_true_gives_defaults(self):
+        cfg = guard_config(TrainingConfiguration(extra={"guard": True}))
+        assert cfg == GuardConfig()
+
+    def test_table_overrides(self):
+        cfg = guard_config(TrainingConfiguration(extra={"guard": {
+            "normLimit": 10.0, "maxStrikes": 3, "lkgDepth": 2,
+            "snapshotEvery": 5,
+        }}))
+        assert cfg.norm_limit == 10.0
+        assert cfg.max_strikes == 3
+        assert cfg.lkg_depth == 2
+        assert cfg.snapshot_every == 5
+
+
+class TestAdmissionReason:
+    def test_healthy_payloads_admit(self):
+        ok = np.ones(8, np.float32)
+        assert admission_reason({"params": ok, "fitted": 3}, 1e6) is None
+        assert admission_reason(ok, 1e6) is None
+        assert admission_reason({"inc": 2, "curve": []}, 1e6) is None
+        assert admission_reason({"gap": True}, 1e6) is None
+
+    def test_non_finite_rejects(self):
+        bad = np.ones(8, np.float32)
+        bad[3] = np.nan
+        assert admission_reason({"params": bad}, 1e6) == "non_finite"
+        bad[3] = np.inf
+        assert admission_reason(bad, 1e6) == "non_finite"
+
+    def test_norm_explosion_rejects(self):
+        big = np.full(8, 1e9, np.float32)
+        assert admission_reason({"params": big}, 1e6) == "norm_exploded"
+        assert admission_reason({"params": big}, 1e12) is None
+
+    def test_scalar_float_poison_rejects(self):
+        # FGM ships phi floats that fold into the shared quantum
+        assert admission_reason({"phi": float("nan")}, 1e6) == "non_finite"
+        # ...but NaN curve points must not block a healed worker's push
+        assert admission_reason(
+            {"params": np.ones(4, np.float32),
+             "curve": [(float("nan"), 3)], "fitted": 3},
+            1e6,
+        ) is None
+
+
+class TestModelGuard:
+    def _pipeline(self, cfg=None):
+        return MLPipeline(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=4,
+            guard=cfg or GuardConfig(),
+        )
+
+    def test_fit_notes_health_and_check_trips_on_nan(self):
+        p = self._pipeline()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.ones(8, np.float32)
+        m = np.ones(8, np.float32)
+        p.fit(x, y, m)
+        assert p.guard.check() is None
+        flat, _ = p.get_flat_params()
+        p.guard.maybe_snapshot(p)
+        p.set_flat_params(np.full_like(flat, np.nan))
+        p.fit(x, y, m)
+        assert p.guard.check() == "non_finite"
+
+    def test_rollback_restores_last_known_good(self):
+        p = self._pipeline()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.ones(8, np.float32)
+        m = np.ones(8, np.float32)
+        p.fit(x, y, m)
+        good, _ = p.get_flat_params()
+        p.guard.maybe_snapshot(p)
+        p.set_flat_params(np.full_like(good, np.nan))
+        assert p.guard.rollback(p)
+        flat, _ = p.get_flat_params()
+        np.testing.assert_array_equal(flat, good)
+
+    def test_ring_is_bounded_and_keeps_newest(self):
+        p = self._pipeline(GuardConfig(lkg_depth=2, snapshot_every=1))
+        vals = []
+        for k in range(4):
+            p.set_flat_params(np.full(5, float(k), np.float32))
+            p.guard._fits_since_snapshot = 1
+            p.guard.maybe_snapshot(p)
+            vals.append(p.get_flat_params()[0].copy())
+        assert p.guard.lkg_depth == 2
+        p.guard.rollback(p)
+        np.testing.assert_array_equal(p.get_flat_params()[0], vals[-1])
+
+    def test_norm_limit_trips(self):
+        p = self._pipeline(GuardConfig(norm_limit=10.0))
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.ones(8, np.float32)
+        m = np.ones(8, np.float32)
+        p.fit(x, y, m)
+        assert p.guard.check() is None
+        p.set_flat_params(np.full(5, 1e4, np.float32))
+        p.fit(x, y, m)
+        assert p.guard.check() == "norm_exploded"
+
+    def test_unguarded_pipeline_has_no_guard_state(self):
+        p = MLPipeline(LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=4)
+        assert p.guard is None
+        assert p.cache_key[-1] is False
+
+
+# --- guard-off / guard-on clean-stream identity -----------------------------
+
+
+class TestGuardIdentity:
+    def _scores(self, guard, codec=None, cohort="off", n_pipe=1,
+                parallelism=2):
+        x, y = make_stream(3072)
+        reqs = [
+            create_request(pid, guard=guard, codec=codec)
+            for pid in range(n_pipe)
+        ]
+        report, job = run_job(
+            x, y, reqs, parallelism=parallelism, cohort=cohort
+        )
+        flats = {
+            nid: net.pipeline.get_flat_params()[0]
+            for nid, net in job.spokes[0].nets.items()
+        }
+        return {s.pipeline: s.score for s in report.statistics}, flats
+
+    def test_solo_clean_stream_bitwise(self):
+        off_scores, off_flats = self._scores(None)
+        on_scores, on_flats = self._scores(True)
+        assert on_scores == off_scores
+        for nid in off_flats:
+            np.testing.assert_array_equal(off_flats[nid], on_flats[nid])
+
+    def test_codec_int8_clean_stream_bitwise(self):
+        off_scores, off_flats = self._scores(None, codec="int8")
+        on_scores, on_flats = self._scores(True, codec="int8")
+        assert on_scores == off_scores
+        for nid in off_flats:
+            np.testing.assert_array_equal(off_flats[nid], on_flats[nid])
+
+    def test_cohort_clean_stream_bitwise(self):
+        off_scores, off_flats = self._scores(
+            None, cohort="on", n_pipe=3, parallelism=1
+        )
+        on_scores, on_flats = self._scores(
+            True, cohort="on", n_pipe=3, parallelism=1
+        )
+        assert on_scores == off_scores
+        for nid in off_flats:
+            np.testing.assert_array_equal(off_flats[nid], on_flats[nid])
+
+
+# --- poisoned-worker recovery, per protocol family --------------------------
+
+
+class TestPoisonedWorkerRecovery:
+    """Seeded channel corruption (NaN + exploding deltas) against every
+    parameter protocol: hub-side admission rejects the poison before it
+    enters round accounting; the job finishes inside the fault-free score
+    envelope with the counters engaged."""
+
+    # GM/FGM exchange params only on violation collections, so their few
+    # pushes need a higher corruption probability to be hit at all
+    CHAOS = "seed=7,up.nan=0.05,up.explode=0.05"
+    CHAOS_RARE_PUSH = "seed=3,up.nan=0.3,up.explode=0.3"
+
+    @pytest.mark.parametrize("protocol", PARAM_PROTOCOLS)
+    def test_recovery_within_envelope(self, protocol):
+        x, y = make_stream(4096)
+        chaos = (
+            self.CHAOS_RARE_PUSH if protocol in ("GM", "FGM") else self.CHAOS
+        )
+        extra = {"threshold": 0.3} if protocol in ("GM", "FGM") else {}
+        clean, _ = run_job(
+            x, y, [create_request(protocol=protocol, guard=True, extra=extra)]
+        )
+        poisoned, _ = run_job(
+            x, y,
+            [create_request(protocol=protocol, guard=True, extra=extra)],
+            chaos=chaos,
+        )
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert ps.deltas_rejected > 0, (
+            f"{protocol}: corruption never hit the admission boundary — "
+            "the test is vacuous"
+        )
+        assert abs(ps.score - cs.score) <= 0.05
+
+    def test_unguarded_chaos_poison_corrupts_or_survives(self):
+        # control: the SAME corruption with the guard off must actually
+        # reach protocol state (otherwise the recovery test proves
+        # nothing). Asynchronous averages every push, so one NaN push
+        # poisons the global model and every replica it touches.
+        x, y = make_stream(4096)
+        report, job = run_job(
+            x, y, [create_request(protocol="Asynchronous")],
+            chaos=self.CHAOS,
+        )
+        [s] = report.statistics
+        flats = [
+            net.pipeline.get_flat_params()[0]
+            for spoke in job.spokes for net in spoke.nets.values()
+        ]
+        poisoned = (not np.isfinite(s.score)) or any(
+            not np.isfinite(f).all() for f in flats
+        ) or s.score < 0.6
+        assert poisoned, (
+            "unguarded chaos corruption left no trace — raise the "
+            "injection rate so the guarded test stays meaningful"
+        )
+        assert s.deltas_rejected == 0  # guard off: nothing rejected
+
+
+class TestWorkerRollback:
+    def test_nan_poke_rolls_back_and_recovers(self):
+        # CentralizedTraining (parallelism 1): the hub holds no usable
+        # authoritative params for this worker's recovery, so the LKG
+        # ring is what saves it
+        x, y = make_stream(4096)
+        req = create_request(protocol="CentralizedTraining", guard=True)
+        clean, _ = run_job(x, y, [req], parallelism=1)
+        poisoned, job = run_job(
+            x, y, [req], parallelism=1, poke=nan_poke()
+        )
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert ps.rollbacks_performed >= 1
+        flat, _ = job.spokes[0].nets[0].pipeline.get_flat_params()
+        assert np.isfinite(flat).all()
+        assert abs(ps.score - cs.score) <= 0.05
+
+    def test_sync_nan_poke_heals_via_hub_resync(self):
+        # with live hub state, admission rejects the poisoned push and the
+        # OP_RESYNC catch-up restores the worker (no crash, envelope held)
+        x, y = make_stream(4096)
+        req = create_request(protocol="Synchronous", guard=True)
+        clean, _ = run_job(x, y, [req])
+        poisoned, job = run_job(x, y, [req], poke=nan_poke())
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert ps.deltas_rejected + ps.rollbacks_performed >= 1
+        for spoke in job.spokes:
+            flat, _ = spoke.nets[0].pipeline.get_flat_params()
+            assert np.isfinite(flat).all()
+        assert abs(ps.score - cs.score) <= 0.05
+
+    def test_guarded_int8_codec_nan_never_crashes(self):
+        # dim >= minLeafSize so params actually encode: the int8 kernel's
+        # loud non-finite failure must be contained by the guard (ship
+        # suppressed, rollback recovers) instead of crashing the job
+        x, y = make_stream(4096, dim=32)
+        req = create_request(
+            protocol="Asynchronous", dim=32, guard=True, codec="int8"
+        )
+        clean, _ = run_job(x, y, [req])
+        poisoned, job = run_job(x, y, [req], poke=nan_poke())
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert ps.rollbacks_performed >= 1
+        flat, _ = job.spokes[0].nets[0].pipeline.get_flat_params()
+        assert np.isfinite(flat).all()
+        assert abs(ps.score - cs.score) <= 0.05
+
+
+# --- cohort eviction --------------------------------------------------------
+
+
+class TestCohortEviction:
+    N_PIPE = 4
+    BAD = 2
+
+    def _run(self, poke):
+        x, y = make_stream(4096)
+        reqs = [
+            create_request(pid, guard=True) for pid in range(self.N_PIPE)
+        ]
+        return run_job(
+            x, y, reqs, parallelism=1, cohort="on", poke=poke
+        )
+
+    def test_diverging_member_evicts_solo_and_recovers(self):
+        report, job = self._run(nan_poke(net_id=self.BAD))
+        bad_net = job.spokes[0].nets[self.BAD]
+        assert bad_net.pipeline._cohort is None  # checked out to solo
+        total_evicted = sum(s.members_evicted for s in report.statistics)
+        total_rollbacks = sum(
+            s.rollbacks_performed for s in report.statistics
+        )
+        assert total_evicted == 1
+        assert total_rollbacks >= 1
+        flat, _ = bad_net.pipeline.get_flat_params()
+        assert np.isfinite(flat).all()
+        # healthy members stay attached
+        for pid in range(self.N_PIPE):
+            if pid == self.BAD:
+                continue
+            assert job.spokes[0].nets[pid].pipeline._cohort is not None
+
+    def test_healthy_members_bitwise_unchanged_by_eviction(self):
+        clean, clean_job = self._run(None)
+        poisoned, pois_job = self._run(nan_poke(net_id=self.BAD))
+        clean_scores = {s.pipeline: s.score for s in clean.statistics}
+        pois_scores = {s.pipeline: s.score for s in poisoned.statistics}
+        for pid in range(self.N_PIPE):
+            if pid == self.BAD:
+                continue
+            assert pois_scores[pid] == clean_scores[pid]
+            np.testing.assert_array_equal(
+                clean_job.spokes[0].nets[pid].pipeline.get_flat_params()[0],
+                pois_job.spokes[0].nets[pid].pipeline.get_flat_params()[0],
+            )
+
+
+# --- record quarantine ------------------------------------------------------
+
+
+POISON_LINES = [
+    '{"numericalFeatures": [NaN, 1.0], "target": 1.0}',
+    '{"numericalFeatures": [1e999], "target": 0.0}',
+    '{"numericalFeatures": [1.0], "target": Infinity}',
+    '{"numericalFeatures": [1.0], "operation": "explode"}',
+    'garbage{{{',
+    '[]',
+    '{"target": 1.0}',
+]
+
+
+class TestRecordQuarantine:
+    def _event_job(self, lines, dead_letter_path=""):
+        job = StreamJob(JobConfig(
+            parallelism=1, batch_size=8, test_set_size=16,
+            dead_letter_path=dead_letter_path,
+        ))
+        job.process_event(REQUEST_STREAM, create_request(dim=4))
+        for line in lines:
+            job.process_event(TRAINING_STREAM, line)
+        return job
+
+    @staticmethod
+    def _valid_lines(n=64, dim=4, seed=5):
+        rng = np.random.RandomState(seed)
+        return [
+            json.dumps({
+                "numericalFeatures": [float(v) for v in rng.randn(dim)],
+                "target": float(i % 2),
+            })
+            for i in range(n)
+        ]
+
+    def test_poison_records_quarantined_with_reasons(self):
+        lines = self._valid_lines()
+        mixed = []
+        for i, line in enumerate(lines):
+            mixed.append(line)
+            if i < len(POISON_LINES):
+                mixed.append(POISON_LINES[i])
+        job = self._event_job(mixed)
+        assert job.dead_letter.record_count == len(POISON_LINES)
+        reasons = {e["reason"] for e in job.dead_letter.entries}
+        assert reasons == {
+            "non_finite_feature", "non_finite_target", "unknown_operation",
+            "malformed_json", "not_an_object", "no_features",
+        }
+        report = job.terminate()
+        [s] = report.statistics
+        assert s.records_quarantined == len(POISON_LINES)
+
+    def test_eos_and_blank_are_markers_not_poison(self):
+        job = self._event_job(["EOS", '"EOS"', "", "   "])
+        assert job.dead_letter.total == 0
+
+    def test_poison_never_mutates_model_state(self):
+        lines = self._valid_lines()
+        mixed = []
+        for i, line in enumerate(lines):
+            mixed.append(line)
+            mixed.append(POISON_LINES[i % len(POISON_LINES)])
+        job_clean = self._event_job(lines)
+        job_mixed = self._event_job(mixed)
+        np.testing.assert_array_equal(
+            job_clean.spokes[0].nets[0].pipeline.get_flat_params()[0],
+            job_mixed.spokes[0].nets[0].pipeline.get_flat_params()[0],
+        )
+
+    def test_dead_letter_file_written(self, tmp_path):
+        path = str(tmp_path / "dead.jsonl")
+        job = self._event_job(POISON_LINES, dead_letter_path=path)
+        job.dead_letter.close()
+        with open(path) as fh:
+            entries = [json.loads(line) for line in fh]
+        assert len(entries) == len(POISON_LINES)
+        assert all(
+            e["stream"] == TRAINING_STREAM and e["reason"] and "payload" in e
+            for e in entries
+        )
+
+    def test_rejected_requests_quarantined_with_detail(self):
+        job = StreamJob(JobConfig(parallelism=1))
+        job.process_event(REQUEST_STREAM, "not json at all {{")
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 7, "request": "Create",
+            "learner": {"name": "NoSuchLearner"},
+        }))
+        assert job.dead_letter.request_count == 2
+        reasons = [e["reason"] for e in job.dead_letter.entries]
+        assert reasons == ["malformed_request", "rejected_request"]
+        assert "NoSuchLearner" in job.dead_letter.entries[-1]["detail"]
+        assert job.pipeline_manager.live_pipelines == []
+
+
+# --- chaos injector units ---------------------------------------------------
+
+
+class TestChaosPoisonInjectors:
+    def _channel(self, **kw):
+        from omldm_tpu.runtime.supervisor import ChaosChannel
+
+        out = []
+        chan = ChaosChannel(
+            lambda *args: out.append(args), seed=5, name="t", **kw
+        )
+        return chan, out
+
+    def _send_pushes(self, chan, n=60):
+        for i in range(n):
+            chan.send(0, 0, 0, "push",
+                      {"params": np.ones(8, np.float32), "fitted": i}, i)
+
+    def test_nan_injection_is_seeded_and_counted(self):
+        chan, out = self._channel(nan=0.2)
+        self._send_pushes(chan)
+        corrupted = [
+            a for a in out if not np.isfinite(a[4]["params"]).all()
+        ]
+        assert chan.corrupted > 0
+        assert len(corrupted) == chan.corrupted
+        # determinism: same seed, same schedule
+        chan2, out2 = self._channel(nan=0.2)
+        self._send_pushes(chan2)
+        assert chan2.corrupted == chan.corrupted
+        for a, b in zip(out, out2):
+            np.testing.assert_array_equal(a[4]["params"], b[4]["params"])
+
+    def test_explode_scales_past_guard_limit(self):
+        chan, out = self._channel(explode=1.0)
+        chan.send(0, 0, 0, "push", {"params": np.ones(8, np.float32)}, 0)
+        [args] = out
+        assert float(np.linalg.norm(args[4]["params"])) > 1e6
+        assert admission_reason(args[4], 1e6) == "norm_exploded"
+
+    def test_control_payloads_never_corrupt(self):
+        chan, out = self._channel(nan=1.0, explode=1.0)
+        chan.send(0, 0, 0, "zeta", {"inc": 3, "curve": []}, 0)
+        chan.send(0, 0, 0, "nack", {"gap": True}, 1)
+        assert chan.corrupted == 0
+        assert out[0][4] == {"inc": 3, "curve": []}
+
+    def test_original_payload_object_not_mutated(self):
+        chan, _ = self._channel(nan=1.0)
+        params = np.ones(8, np.float32)
+        chan.send(0, 0, 0, "push", {"params": params}, 0)
+        assert np.isfinite(params).all()
+
+    def test_loss_only_specs_keep_their_schedule(self):
+        # arming ZERO corruption draws nothing extra from the RNG: the
+        # drop/dup schedule of pre-existing specs is unchanged
+        chan_a, out_a = self._channel(drop=0.3)
+        chan_b, out_b = self._channel(drop=0.3, nan=0.0, explode=0.0)
+        self._send_pushes(chan_a)
+        self._send_pushes(chan_b)
+        assert chan_a.dropped == chan_b.dropped
+        assert len(out_a) == len(out_b)
+
+    def test_consumer_poison_records(self):
+        from omldm_tpu.api.data import DataInstance
+        from omldm_tpu.runtime.supervisor import ChaosConsumer
+
+        class Rec:
+            def __init__(self, i):
+                self.topic = "trainingData"
+                self.value = json.dumps(
+                    {"numericalFeatures": [1.0, 2.0], "target": 1.0}
+                )
+                self.partition = 0
+                self.offset = i
+
+        inner = iter([Rec(i) for i in range(200)])
+        consumer = ChaosConsumer(inner, seed=9, poison=0.2)
+        seen = list(consumer)
+        assert consumer.poisoned > 0
+        bad = [r for r in seen if DataInstance.from_json(r.value) is None]
+        assert len(bad) == consumer.poisoned
+        # every poisoned record still names its topic/offset (quarantine
+        # entries stay attributable)
+        assert all(r.topic == "trainingData" for r in bad)
+
+
+# --- hub admission through a real Hub ---------------------------------------
+
+
+class TestHubAdmission:
+    def _hub(self, protocol="Asynchronous", max_strikes=1, workers=3):
+        from omldm_tpu.api.requests import Request, RequestType
+        from omldm_tpu.runtime.hub import Hub
+
+        sent = []
+        request = Request(
+            id=0, request=RequestType.CREATE,
+            learner=LearnerSpec(
+                "PA", hyper_parameters={"C": 1.0},
+                data_structure={"nFeatures": 8},
+            ),
+            training_configuration=TrainingConfiguration(
+                protocol=protocol,
+                extra={"guard": {"maxStrikes": max_strikes}},
+            ),
+        )
+        hub = Hub(
+            0, 0, request, 8, JobConfig(parallelism=workers),
+            reply=lambda w, op, payload: sent.append((w, op)),
+            broadcast=lambda op, payload: sent.append(("*", op)),
+        )
+        return hub, sent
+
+    def _push(self, vec, fitted=1):
+        return {"params": vec, "curve": [], "fitted": fitted}
+
+    def test_reject_then_retire_then_readmit(self):
+        hub, sent = self._hub()
+        good = np.ones(13, np.float32)
+        bad = good.copy()
+        bad[0] = np.nan
+        hub.receive(0, "push", self._push(good))
+        assert hub.node.stats.deltas_rejected == 0
+        hub.receive(1, "push", self._push(bad))
+        assert hub.node.stats.deltas_rejected == 1
+        assert 1 in hub.node._guard_retired
+        assert hub.node.round_target() == 2
+        # authoritative resync went to the offender
+        assert (1, "resync") in sent
+        # healthy params push re-admits
+        hub.receive(1, "push", self._push(good, fitted=2))
+        assert 1 not in hub.node._guard_retired
+        assert hub.node.round_target() == 3
+
+    def test_rejected_push_never_reaches_round_accounting(self):
+        hub, _ = self._hub(protocol="Synchronous", workers=2)
+        bad = np.full(13, np.nan, np.float32)
+        hub.receive(0, "push", self._push(bad))
+        assert hub.node._round == {}
+        assert hub.node.stats.fitted == 0
+
+    def test_sync_barrier_releases_without_poisoned_worker(self):
+        hub, sent = self._hub(protocol="Synchronous", workers=2)
+        good = np.ones(13, np.float32)
+        bad = np.full(13, np.inf, np.float32)
+        hub.receive(0, "push", self._push(good))
+        assert not any(op == "update" for _, op in sent)
+        # worker 1 is poisoned: its push rejects, it retires, and the
+        # round releases on worker 0's contribution alone
+        hub.receive(1, "push", self._push(bad))
+        assert any(op == "update" for _, op in sent)
+
+    def test_strike_budget_respected(self):
+        hub, _ = self._hub(max_strikes=2)
+        bad = np.full(13, np.nan, np.float32)
+        hub.receive(1, "push", self._push(bad))
+        assert 1 not in hub.node._guard_retired
+        hub.receive(1, "push", self._push(bad))
+        assert 1 in hub.node._guard_retired
+
+    def test_guard_off_has_no_admission(self):
+        from omldm_tpu.api.requests import Request, RequestType
+        from omldm_tpu.runtime.hub import Hub
+
+        request = Request(
+            id=0, request=RequestType.CREATE,
+            learner=LearnerSpec(
+                "PA", hyper_parameters={"C": 1.0},
+                data_structure={"nFeatures": 8},
+            ),
+            training_configuration=TrainingConfiguration(
+                protocol="Asynchronous"
+            ),
+        )
+        hub = Hub(
+            0, 0, request, 8, JobConfig(parallelism=2),
+            reply=lambda *a: None, broadcast=lambda *a: None,
+        )
+        assert not hub.node.guard_armed
+        bad = np.full(13, np.nan, np.float32)
+        hub.receive(0, "push", self._push(bad))
+        # pre-guard behavior: the poison lands in the global (silently)
+        assert not np.isfinite(hub.node.global_params).all()
+        assert hub.node.stats.deltas_rejected == 0
+
+
+class TestReviewRegressions:
+    """Pins for the review findings on the guard layer."""
+
+    def test_trip_with_no_hub_state_does_not_starve_sync_barrier(self):
+        # poison BEFORE any round completes: the hub has no authoritative
+        # params to resync, so recovery must come from the LKG rollback +
+        # healthy re-push (not from a resync that ships nothing)
+        x, y = make_stream(4096)
+        req = create_request(protocol="Synchronous", guard=True)
+        job = StreamJob(JobConfig(
+            parallelism=2, batch_size=32, test_set_size=64,
+        ))
+        job.process_event(REQUEST_STREAM, req)
+        nan_poke()(job)  # worker 0 is corrupt from record zero
+        op = np.zeros((x.shape[0],), np.uint8)
+        for i in range(0, x.shape[0], 512):
+            job.process_packed_batch(x[i:i+512], y[i:i+512], op[i:i+512])
+        report = job.terminate()
+        [s] = report.statistics
+        # the fleet kept training (no permanently-blocked worker)...
+        assert s.fitted > x.shape[0] // 2
+        assert s.score > 0.8
+        # ...and the poisoned worker recovered to finite params
+        for spoke in job.spokes:
+            flat, _ = spoke.nets[0].pipeline.get_flat_params()
+            assert np.isfinite(flat).all()
+            assert not spoke.nets[0].node.waiting
+
+    def test_finite_payload_encode_failure_still_raises_under_guard(self):
+        # the guarded ship boundary only swallows encode failures caused
+        # by genuinely non-finite payloads; any other codec error is a
+        # bug and must propagate even with the guard armed
+        from omldm_tpu.protocols.registry import make_worker_node
+
+        tc = TrainingConfiguration(
+            protocol="Asynchronous",
+            extra={"guard": True, "comm": {"codec": "int8"}},
+        )
+        pipeline = MLPipeline(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=32,
+            guard=GuardConfig(),
+        )
+        node = make_worker_node(
+            "Asynchronous", pipeline, 0, 2, tc, lambda *a: None
+        )
+
+        class BrokenCodec:
+            def encode(self, payload, stream):
+                raise ValueError("unrelated codec defect")
+
+        node.codec = BrokenCodec()
+        finite = {"params": np.ones(32, np.float32)}
+        with pytest.raises(ValueError, match="unrelated codec defect"):
+            node._send_encoded("push", finite, 0)
+        # ...while a genuinely non-finite payload is suppressed
+        bad = {"params": np.full(32, np.nan, np.float32)}
+        node._send_encoded("push", bad, 0)  # must not raise
+
+    def test_per_record_target_clamps_to_float32_range(self):
+        # a finite-double target beyond float32 range must clamp (the
+        # packed/C route behavior), not overflow to inf in the batcher
+        job = StreamJob(JobConfig(parallelism=1, batch_size=4, test=False))
+        job.process_event(REQUEST_STREAM, create_request(dim=4))
+        for i in range(8):
+            job.process_event(TRAINING_STREAM, json.dumps({
+                "numericalFeatures": [1.0, 0.5, -0.5, 0.25],
+                "target": 1e200 if i % 2 else -1e200,
+            }))
+        net = job.spokes[0].nets[0]
+        flat, _ = net.pipeline.get_flat_params()
+        assert np.isfinite(flat).all()
+        assert net.pipeline.fitted == 8
+
+    def test_validate_then_apply_still_admits_update_and_delete(self):
+        job = StreamJob(JobConfig(parallelism=1))
+        job.process_event(REQUEST_STREAM, create_request(dim=4))
+        assert job.pipeline_manager.live_pipelines == [0]
+        update = json.loads(create_request(dim=4))
+        update["request"] = "Update"
+        job.process_event(REQUEST_STREAM, json.dumps(update))
+        assert job.pipeline_manager.live_pipelines == [0]
+        job.process_event(
+            REQUEST_STREAM, json.dumps({"id": 0, "request": "Delete"})
+        )
+        assert job.pipeline_manager.live_pipelines == []
+        assert job.dead_letter.request_count == 0
+
+
+class TestChaosCorruptionUnderCodec:
+    """The nan/explode injectors must not go silently inert when a
+    transport codec is armed: the on-wire (encoded) params corrupt too,
+    and the guard's admission boundary still catches the decode."""
+
+    def test_encoded_leaf_corruption_engages_admission(self):
+        # dim >= minLeafSize so the int8 codec actually encodes params
+        x, y = make_stream(4096, dim=32)
+        req = create_request(
+            protocol="Asynchronous", dim=32, guard=True, codec="int8"
+        )
+        clean, _ = run_job(x, y, [req])
+        poisoned, job = run_job(
+            x, y, [req], chaos="seed=7,up.nan=0.05,up.explode=0.05"
+        )
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert job._chaos_up.corrupted > 0, (
+            "codec-armed pipeline saw zero injected corruptions — the "
+            "nan/explode classes are inert again"
+        )
+        assert ps.deltas_rejected > 0
+        assert abs(ps.score - cs.score) <= 0.05
+
+    def test_corrupt_payload_handles_each_leaf_kind(self):
+        from omldm_tpu.runtime.codec import TransportCodec, decode_payload
+        from omldm_tpu.runtime.supervisor import _chaos_rng, _corrupt_payload
+
+        rng = _chaos_rng(5, "t")
+        vec = np.random.RandomState(0).randn(64).astype(np.float32)
+        for kind in ("fp16", "int8", "topk"):
+            tx = TransportCodec(kind, min_leaf_size=4, top_k=8)
+            rx = TransportCodec(kind, min_leaf_size=4, top_k=8)
+            payload = tx.encode({"params": vec.copy()}, stream="w0>h0")
+            bad = _corrupt_payload(payload, "nan", rng)
+            assert bad is not None, f"{kind}: corruption returned None"
+            dec = decode_payload(bad, rx)["params"]
+            assert not np.isfinite(dec).all(), (
+                f"{kind}: corrupted leaf decoded finite"
+            )
+            # the original encoded payload was not mutated
+            dec_orig = decode_payload(
+                payload, TransportCodec(kind, min_leaf_size=4, top_k=8)
+            )["params"]
+            assert np.isfinite(dec_orig).all()
+
+
+class TestRoundThreeRegressions:
+    """Pins for the codec-interaction and snapshot-integrity findings."""
+
+    def test_topk_rejection_realigns_delta_bases(self):
+        # a chaos-corrupted topk delta poisons the hub's rx base at decode
+        # time (before admission): the rejection must reset the base and
+        # re-anchor the sender, or every later HEALTHY delta from that
+        # worker keeps decoding corrupt and being rejected until the
+        # anchor cycle (up to anchorEvery=64 pushes away)
+        x, y = make_stream(6144, dim=32)
+        req = create_request(
+            protocol="Asynchronous", dim=32, guard=True, codec="topk",
+            sync_every=2,
+        )
+        clean, _ = run_job(x, y, [req])
+        poisoned, job = run_job(
+            x, y, [req], chaos="seed=11,up.nan=0.04"
+        )
+        [cs] = clean.statistics
+        [ps] = poisoned.statistics
+        assert job._chaos_up.corrupted > 0
+        assert ps.deltas_rejected > 0
+        # realignment bound: rejections stay commensurate with injected
+        # corruptions instead of snowballing toward the anchor cycle
+        assert ps.deltas_rejected <= 4 * job._chaos_up.corrupted
+        # containment, not parity: a forced re-anchor restarts the topk
+        # stream from a zero base and the k-sparse rebuild transiently
+        # degrades the averaged model — topk's documented contract is
+        # "converges within one anchor cycle", so the bar here is a
+        # finite, learning model (score >> chance), not the 0.05 envelope
+        # the dense codecs hold
+        assert ps.score > 0.7
+        assert abs(ps.score - cs.score) <= 0.25
+        for spoke in job.spokes:
+            flat, _ = spoke.nets[0].pipeline.get_flat_params()
+            assert np.isfinite(flat).all()
+
+    def test_snapshot_refuses_corrupt_params(self):
+        # a hub broadcast can replace params AFTER the last fit's health
+        # evidence: the ring must reject a non-finite copy instead of
+        # storing it as "last known good"
+        p = MLPipeline(
+            LearnerSpec("PA", hyper_parameters={"C": 1.0}), dim=4,
+            guard=GuardConfig(snapshot_every=1),
+        )
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        p.fit(x, np.ones(8, np.float32), np.ones(8, np.float32))
+        p.guard.check()
+        p.guard.maybe_snapshot(p)
+        good = p.get_flat_params()[0]
+        p.set_flat_params(np.full_like(good, np.nan))
+        p.guard._fits_since_snapshot = 99
+        p.guard.maybe_snapshot(p)  # must refuse the NaN copy
+        assert p.guard.rollback(p)
+        np.testing.assert_array_equal(p.get_flat_params()[0], good)
+
+    def test_empty_or_nonfloat_params_never_readmit(self):
+        # re-admission requires a model vector admission actually judged
+        from omldm_tpu.protocols.base import HubNode
+
+        assert not HubNode._carries_params(
+            {"params": np.zeros((0,), np.float32)}
+        )
+        assert not HubNode._carries_params(
+            {"params": np.ones(4, np.int32)}
+        )
+        assert HubNode._carries_params({"params": np.ones(4, np.float32)})
+
+    def test_dead_letter_file_closed_at_terminate(self, tmp_path):
+        path = str(tmp_path / "dl.jsonl")
+        job = StreamJob(JobConfig(parallelism=1, dead_letter_path=path))
+        job.process_event(REQUEST_STREAM, create_request(dim=4))
+        job.process_event(TRAINING_STREAM, "garbage{{{")
+        assert job.dead_letter._fh is not None
+        job.terminate()
+        assert job.dead_letter._fh is None
+
+
+class TestRoundFourRegressions:
+    def test_poison_never_mutates_request_topic(self):
+        # a poisoned record's offset advances (no replay), so the control
+        # stream must be exempt — destroying a Create would silently
+        # change the topology forever
+        from omldm_tpu.runtime.supervisor import ChaosConsumer
+
+        class Rec:
+            def __init__(self, i, topic):
+                self.topic = topic
+                self.value = json.dumps({"id": i, "request": "Delete"}) \
+                    if topic == "requests" else json.dumps(
+                        {"numericalFeatures": [1.0], "target": 0.0})
+                self.partition = 0
+                self.offset = i
+
+        recs = [Rec(i, "requests" if i % 3 == 0 else "trainingData")
+                for i in range(300)]
+        consumer = ChaosConsumer(
+            iter(recs), seed=9, poison=0.5,
+            poison_exempt_topics=("requests",),
+        )
+        seen = list(consumer)
+        assert consumer.poisoned > 0
+        for r in seen:
+            if r.topic == "requests":
+                assert json.loads(r.value)["request"] == "Delete"
+
+    def test_guard_retirement_respects_quorum_floor(self):
+        from omldm_tpu.api.requests import Request, RequestType
+        from omldm_tpu.runtime.hub import Hub
+
+        request = Request(
+            id=0, request=RequestType.CREATE,
+            learner=LearnerSpec(
+                "PA", hyper_parameters={"C": 1.0},
+                data_structure={"nFeatures": 8},
+            ),
+            training_configuration=TrainingConfiguration(
+                protocol="Synchronous",
+                extra={"guard": True, "comm": {"quorum": 3}},
+            ),
+        )
+        hub = Hub(
+            0, 0, request, 8, JobConfig(parallelism=4),
+            reply=lambda *a: None, broadcast=lambda *a: None,
+        )
+        bad = np.full(13, np.nan, np.float32)
+        push = {"params": bad, "curve": [], "fitted": 1}
+        hub.receive(0, "push", dict(push))
+        assert 0 in hub.node._guard_retired  # 4 -> 3 active: allowed
+        hub.receive(1, "push", dict(push))
+        # 3 active == quorum floor: worker 1 must NOT retire
+        assert 1 not in hub.node._guard_retired
+        assert hub.node.round_target() == 3
+        assert hub.node.stats.deltas_rejected == 2

@@ -183,7 +183,10 @@ class TestChaosSpec:
     def test_parse_directions_and_defaults(self):
         spec = parse_chaos_spec("seed=9,drop=0.1,up.dup=0.2,window=6")
         assert spec["seed"] == 9 and spec["window"] == 6
-        assert spec["up"] == {"drop": 0.1, "dup": 0.2, "reorder": 0.0, "delay": 0.0}
+        assert spec["up"] == {
+            "drop": 0.1, "dup": 0.2, "reorder": 0.0, "delay": 0.0,
+            "nan": 0.0, "explode": 0.0, "poison": 0.0,
+        }
         assert spec["down"]["dup"] == 0.0 and spec["down"]["drop"] == 0.1
         assert parse_chaos_spec("") is None
         with pytest.raises(ValueError):
