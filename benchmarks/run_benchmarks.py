@@ -1168,7 +1168,14 @@ def main():
              # multi-tenant sweep: per-tenant + aggregate ex/s for N
              # co-hosted same-spec pipelines, per-pipeline dispatch vs
              # cohort gang dispatch, with programLaunches per run
-             "--pipelines", "1,8,64,256"],
+             "--pipelines", "1,8,64,256",
+             # forecast-heavy serving sweep (benchmarks/streams.py): the
+             # run_benchmarks legs are otherwise training-dominated, so
+             # BENCH rounds record the serving-throughput axis here —
+             # per-record vs adaptive-batching serving (exact + relaxed)
+             # at a 50/50 train/forecast mix, 64 co-hosted tenants, with
+             # forecastsServed + latency percentiles per run
+             "--forecast-mix", "0.5"],
             capture_output=True, text=True, timeout=3600,
             env={**os.environ, "PYTHONPATH": child_path},
         )

@@ -1,0 +1,54 @@
+"""Shared synthetic stream generators for the benchmark harnesses.
+
+``run_benchmarks.py``'s legs historically exercised training-dominated
+streams only (forecast ops were a thin sprinkle, e.g. the multi-tenant
+sweep's 0%); the serving plane needs forecast-HEAVY streams measured the
+same way everywhere. This module is the one definition shared by the
+``protocol_comparison.py --serve-smoke`` CI gate and the
+``--forecast-mix`` sweep that ``run_benchmarks.py`` records each BENCH
+round — so the gate and the trajectory always measure the same task.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def forecast_stream(records: int, dim: int = 28, mix: float = 0.5,
+                    seed: int = 0, tail_train: int = 768):
+    """A linearly-separable stream with a ``mix`` fraction of forecasting
+    rows spread evenly across stream positions.
+
+    Returns ``(x, y, op)`` for the packed route: ``x [n, dim]`` float32
+    features, ``y [n]`` float32 targets (zeros on forecast rows — the
+    packed path ignores them), ``op [n]`` uint8 (0=training,
+    1=forecasting). The forecast positions are deterministic in
+    ``(records, mix)``: every ``round(1/mix)``-th row when mix <= 0.5,
+    the complement pattern above — so a 0.5 mix strictly alternates and
+    consecutive runs are reproducible without an op-level RNG draw.
+
+    The last ``tail_train`` rows are training-only: forecasts queued by
+    the adaptive-batching plane then drain through the LIVE flush
+    triggers (fill / model fence / deadline) rather than the terminate
+    probe, so measured latency percentiles reflect steady-state serving,
+    not shutdown."""
+    if not 0.0 <= mix < 1.0:
+        raise ValueError(f"forecast mix must be in [0, 1), got {mix}")
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(42).randn(dim)
+    x = rng.randn(records, dim).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    op = np.zeros((records,), np.uint8)
+    if mix > 0:
+        if mix <= 0.5:
+            stride = int(round(1.0 / mix))
+            op[::stride] = 1
+        else:
+            # mostly-forecast stream: mark the TRAINING rows by stride
+            stride = int(round(1.0 / (1.0 - mix)))
+            op[:] = 1
+            op[::stride] = 0
+        if 0 < tail_train < records:
+            op[records - tail_train:] = 0
+    y[op != 0] = 0.0
+    return x, y, op

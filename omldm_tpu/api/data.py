@@ -26,7 +26,10 @@ FORECASTING = "forecasting"
 EOS = "EOS"
 
 
-@dataclasses.dataclass
+# slots: the serving plane materializes one DataInstance per emitted
+# prediction on its hot path — slot-backed instances construct ~2x faster
+# and every field here is declared up front anyway
+@dataclasses.dataclass(slots=True)
 class DataInstance:
     """One streaming record, either a training or a forecasting point.
 
@@ -84,6 +87,24 @@ class DataInstance:
         DataInstanceParser.scala:13-21: the record must carry features and a
         known operation."""
         return self.invalid_reason() is None
+
+    @classmethod
+    def forecast_payload(cls, numerical_features) -> "DataInstance":
+        """Hot-path factory for the serving plane: the forecasting
+        DataInstance a served prediction carries, built by direct slot
+        fill. One such instance materializes per emitted prediction —
+        at adaptive-batching throughput the generated ``__init__``'s
+        seven keyword assignments are a measurable fraction of the whole
+        serve path, and every field here is statically known."""
+        di = cls.__new__(cls)
+        di.id = None
+        di.numerical_features = numerical_features
+        di.discrete_features = None
+        di.categorical_features = None
+        di.target = None
+        di.operation = FORECASTING
+        di.metadata = None
+        return di
 
     # --- JSON codec (Jackson-compatible camelCase field names) ---
 
@@ -146,7 +167,14 @@ class DataInstance:
         if self.id is not None:
             out["id"] = self.id
         if self.numerical_features is not None:
-            out["numericalFeatures"] = list(self.numerical_features)
+            nf = self.numerical_features
+            # the serving plane's batched emission carries feature rows as
+            # numpy views (materializing per-row python lists would be the
+            # single largest cost of a flush); tolist() lands the SAME
+            # native-float JSON list() produces for list payloads
+            out["numericalFeatures"] = (
+                nf.tolist() if hasattr(nf, "tolist") else list(nf)
+            )
         if self.discrete_features is not None:
             out["discreteFeatures"] = list(self.discrete_features)
         if self.categorical_features is not None:
@@ -161,7 +189,7 @@ class DataInstance:
         return json.dumps(self.to_dict())
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class Prediction:
     """A served prediction, emitted on the predictions stream.
 

@@ -71,6 +71,16 @@ class Statistics:
     # pipeline) and is mirrored into each pipeline's statistics at
     # terminate — it does NOT sum across pipelines to the record count
     records_quarantined: int = 0
+    # forecast serving telemetry (runtime/serving.py): predictions emitted
+    # on this pipeline's behalf, and the enqueue->emit latency percentile
+    # triple (ms) folded in from the spokes' per-record serving clocks —
+    # populated by BOTH the immediate per-record path and the adaptive-
+    # batching serving plane. Percentiles max-combine across contributors
+    # (merge reports the worst observed window, a conservative summary)
+    forecasts_served: int = 0
+    serve_latency_p50_ms: float = 0.0
+    serve_latency_p99_ms: float = 0.0
+    serve_latency_p999_ms: float = 0.0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -91,6 +101,7 @@ class Statistics:
         rollbacks_performed: int = 0,
         members_evicted: int = 0,
         records_quarantined: int = 0,
+        forecasts_served: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127)."""
         self.models_shipped += models_shipped
@@ -105,6 +116,17 @@ class Statistics:
         self.rollbacks_performed += rollbacks_performed
         self.members_evicted += members_evicted
         self.records_quarantined += records_quarantined
+        self.forecasts_served += forecasts_served
+
+    def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
+        """Fold one contributor's serving-latency percentile window in
+        (max-combine: the report carries the worst observed percentiles
+        across spokes/hubs — percentiles are not additive and shipping
+        raw samples through statistics messages would defeat the point
+        of a bounded telemetry plane)."""
+        self.serve_latency_p50_ms = max(self.serve_latency_p50_ms, p50)
+        self.serve_latency_p99_ms = max(self.serve_latency_p99_ms, p99)
+        self.serve_latency_p999_ms = max(self.serve_latency_p999_ms, p999)
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -155,6 +177,16 @@ class Statistics:
             members_evicted=self.members_evicted + other.members_evicted,
             records_quarantined=self.records_quarantined
             + other.records_quarantined,
+            forecasts_served=self.forecasts_served + other.forecasts_served,
+            serve_latency_p50_ms=max(
+                self.serve_latency_p50_ms, other.serve_latency_p50_ms
+            ),
+            serve_latency_p99_ms=max(
+                self.serve_latency_p99_ms, other.serve_latency_p99_ms
+            ),
+            serve_latency_p999_ms=max(
+                self.serve_latency_p999_ms, other.serve_latency_p999_ms
+            ),
             fitted=self.fitted + other.fitted,
             mean_buffer_size=self.mean_buffer_size + other.mean_buffer_size,
             score=self.score + other.score,
@@ -183,6 +215,10 @@ class Statistics:
             "rollbacksPerformed": self.rollbacks_performed,
             "membersEvicted": self.members_evicted,
             "recordsQuarantined": self.records_quarantined,
+            "forecastsServed": self.forecasts_served,
+            "serveLatencyP50Ms": self.serve_latency_p50_ms,
+            "serveLatencyP99Ms": self.serve_latency_p99_ms,
+            "serveLatencyP999Ms": self.serve_latency_p999_ms,
             "numOfBlocks": self.num_of_blocks,
             "fitted": self.fitted,
             "learningCurve": self.learning_curve,

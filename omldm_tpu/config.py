@@ -109,6 +109,18 @@ class JobConfig:
     # a deadline), not per record (runtime/hub.py).
     liveness_stride: int = 16
 
+    # --- adaptive-batching forecast serving (runtime/serving.py; no
+    # reference counterpart: the reference answers every forecasting
+    # record inline, FlinkSpoke.scala:92-107) ---
+    # Job-wide DEFAULT serving spec applied to pipelines whose
+    # trainingConfiguration carries no "serving" table of their own, e.g.
+    # "maxBatch=64,maxDelayMs=5" or "relaxed" or "on". Empty (default):
+    # nothing is armed and every forecast takes the exact pre-plane
+    # immediate per-record predict path. Per-pipeline
+    # trainingConfiguration.serving always wins (an explicit false opts a
+    # pipeline out of this default).
+    serving: str = ""
+
     # --- TPU-native knobs (no reference counterpart) ---
     # Micro-batch size per training step; records are padded + masked to this
     # fixed shape so the jitted step never recompiles.

@@ -273,6 +273,11 @@ class Cohort:
         self._post_slots: set = set()
         self._flat_cache: Optional[np.ndarray] = None
         self._in_launch = False
+        # persistent gang-predict staging pads, keyed by per-slot batch
+        # shape (the serving plane's pow2 row buckets keep this small);
+        # _pred_dirty tracks which slots each pad last wrote
+        self._pred_scratch: Dict[tuple, np.ndarray] = {}
+        self._pred_dirty: Dict[tuple, List[int]] = {}
         self.attach(pipeline)
 
     # --- membership ------------------------------------------------------
@@ -658,13 +663,30 @@ class Cohort:
 
     def predict_rows(self, entries: List[Tuple[int, np.ndarray]]) -> np.ndarray:
         """Gang forecast serving: one padded predict launch over the whole
-        cohort; ``entries`` are (slot, padded batch) pairs and the result
-        indexes ``[slot]`` per participant."""
+        cohort. ``entries`` are ``(slot, padded [B, ...] batch)`` pairs —
+        every batch the same shape, any number of rows (the per-record
+        path passes one PREDICT_BATCH pad per slot; the serving plane
+        passes multi-row queues, batching across stream positions AND
+        tenants). The result indexes ``[slot, row]`` per participant.
+
+        The ``[capacity, B, ...]`` staging pad is a persistent per-shape
+        scratch (the dispatch copies host buffers to device before
+        returning, so reuse is safe — same contract as the fit staging
+        buffers); only previously-written slots re-zero."""
         self.launch()
         x0 = entries[0][1]
-        xs = np.zeros((self.capacity,) + x0.shape, np.float32)
+        shape = (self.capacity,) + x0.shape
+        xs = self._pred_scratch.get(shape[1:])
+        if xs is None or xs.shape != shape:
+            xs = np.zeros(shape, np.float32)
+            self._pred_scratch[shape[1:]] = xs
+            self._pred_dirty.pop(shape[1:], None)
+        else:
+            for slot in self._pred_dirty.get(shape[1:], ()):
+                xs[slot] = 0.0
         for slot, xb in entries:
             xs[slot] = xb
+        self._pred_dirty[shape[1:]] = [slot for slot, _ in entries]
         self._note_launch(entries[0][0])
         with self._timed():
             out = self._gpred(self.stacked, xs)

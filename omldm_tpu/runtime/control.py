@@ -41,7 +41,10 @@ class PipelineManager:
             err = self._validate_sparse(request)
             if err:
                 return err
-            return self._validate_codec(request)
+            err = self._validate_codec(request)
+            if err:
+                return err
+            return self._validate_serving(request)
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
                 return f"pipeline {request.id} does not exist"
@@ -51,7 +54,10 @@ class PipelineManager:
                 err = self._validate_sparse(request)
                 if err:
                     return err
-                return self._validate_codec(request)
+                err = self._validate_codec(request)
+                if err:
+                    return err
+                return self._validate_serving(request)
             return None
         return f"unknown request type {request.request}"
 
@@ -99,6 +105,16 @@ class PipelineManager:
         ).lower() == "spmd":
             return "topk codec is host-plane only (SPMD allreduce needs dense operands)"
         return None
+
+    @staticmethod
+    def _validate_serving(request: Request) -> Optional[str]:
+        """Adaptive-batching serving config must be deployable for the
+        same reason as the codec gate: an unknown staleness mode or a
+        non-positive batch/delay knob would raise at SpokeNet construction
+        and kill the job instead of dropping the one bad request."""
+        from omldm_tpu.runtime.serving import validate_serving
+
+        return validate_serving(request.training_configuration)
 
     def admit(self, request: Request) -> bool:
         """Validate + update the live map; True if the request should be

@@ -64,6 +64,12 @@ class StreamJob:
         self._on_performance = on_performance
 
         self.pipeline_manager = PipelineManager()
+        # fail fast on a malformed job-wide serving default (the
+        # per-pipeline trainingConfiguration.serving table is instead
+        # validated at the control gate and drops only its own request)
+        from omldm_tpu.runtime.serving import parse_serving_spec
+
+        parse_serving_spec(self.config.serving)
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         # dead-letter quarantine: malformed / validation-rejected records
         # and requests land here with reason codes instead of vanishing
@@ -109,6 +115,7 @@ class StreamJob:
                 emit_response=self._route_response_fragment,
                 on_poll=self.stats.mark_activity,
                 note_wire=self._note_wire,
+                emit_predictions=self._emit_predictions,
             )
             for i in range(self.config.parallelism)
         ]
@@ -171,6 +178,15 @@ class StreamJob:
         if self._on_prediction:
             self._on_prediction(pred)
 
+    def _emit_predictions(self, preds: List[Prediction]) -> None:
+        """Bulk twin of :meth:`_emit_prediction` for the serving plane's
+        flush emission — one extend per flush instead of one call per
+        prediction; sink callbacks still fire per prediction, in order."""
+        self.predictions.extend(preds)
+        if self._on_prediction:
+            for pred in preds:
+                self._on_prediction(pred)
+
     def _emit_response(self, resp: QueryResponse) -> None:
         self.responses.append(resp)
         if self._on_response:
@@ -223,13 +239,19 @@ class StreamJob:
         )
 
     def _note_wire(
-        self, network_id: int, hub_id: int, counter: str, n: int
+        self, network_id: int, hub_id: int, counter: str, n
     ) -> None:
-        """Spoke-side reliable-channel events (duplicates dropped, gaps
-        resynced on the hub->worker direction) fold into the pipeline's
-        hub statistics so one report carries both directions."""
+        """Spoke-side events (reliable-channel repairs, program launches,
+        serving telemetry) fold into the pipeline's hub statistics so one
+        report carries both sides. Counters are additive ints except
+        ``serve_latency_ms``, whose payload is the (p50, p99, p999)
+        percentile triple the Statistics plane max-combines."""
         hub = self.hub_manager.hubs.get((network_id, hub_id))
-        if hub is not None:
+        if hub is None:
+            return
+        if counter == "serve_latency_ms":
+            hub.node.stats.note_serve_latency(*n)
+        else:
             hub.node.stats.update_stats(**{counter: n})
 
     # --- event handling ---
@@ -448,6 +470,7 @@ class StreamJob:
                         emit_response=self._route_response_fragment,
                         on_poll=self.stats.mark_activity,
                         note_wire=self._note_wire,
+                        emit_predictions=self._emit_predictions,
                     )
                 )
             self.config.parallelism = n_new
@@ -656,7 +679,12 @@ class StreamJob:
 
     def check_silence(self, now: Optional[float] = None) -> Optional[JobStatistics]:
         """Live-mode hook: fire the termination probe when the silence
-        timeout elapsed (StatisticsOperator.scala:135-142)."""
+        timeout elapsed (StatisticsOperator.scala:135-142). Also the
+        serving plane's idle deadline clock — a queued forecast whose
+        maxDelayMs elapses during stream silence must not wait for the
+        next record to flush it."""
+        for spoke in self.spokes:
+            spoke.poll_serving()
         if self.stats.silence_exceeded(now):
             return self.terminate()
         return None

@@ -19,6 +19,7 @@ fixed-width padded predict batch.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
@@ -41,6 +42,12 @@ from omldm_tpu.runtime.messages import (
     channel_chaos_spec,
     channel_window_size,
     reliability_armed,
+)
+from omldm_tpu.runtime.serving import (
+    ServeStats,
+    ServeQueue,
+    ServingPlane,
+    serving_config,
 )
 from omldm_tpu.runtime.vectorizer import (
     F32_MAX,
@@ -179,6 +186,21 @@ class SpokeNet:
         # retired replica, so its pending fill is no longer a pure suffix
         # of this spoke's stream and shared-ingest grouping must skip it
         self.shared_taint = False
+        # adaptive-batching serving plane (trainingConfiguration.serving /
+        # JobConfig.serving): when armed, forecasting records queue here
+        # and serve in batched predict launches (runtime/serving.py); None
+        # (default) keeps the immediate per-record predict path. The plane
+        # reference is attached by the hosting Spoke at create time.
+        self.serving = serving_config(tc, getattr(config, "serving", ""))
+        self.serve_queue = ServeQueue()
+        self.serve_stats = ServeStats()
+        self._plane: Optional[ServingPlane] = None
+        # persistent padded predict scratch: the per-record, gang and
+        # batched serve paths all pad rows into this reused buffer instead
+        # of allocating a fresh pad batch per forecast record
+        self._scratch = None
+        self._scratch_dirty = 0
+        self.scratch_allocs = 0
         # reliable channel (lossy-channel hardening): per-hub outgoing
         # sequence numbers + per-hub receive windows, armed per pipeline.
         # Unarmed (the default), nothing is stamped or windowed and the
@@ -221,7 +243,63 @@ class SpokeNet:
     def _note_launch(self) -> None:
         self.program_launches += 1
 
+    def predict_pad(self, n: int):
+        """A zeroed padded predict batch with >= ``n`` writable rows, from
+        the net's persistent scratch: ``[B', dim]`` (or a sparse
+        ``(idx, val)`` pair), ``B'`` the pow2 bucket of ``n`` floored at
+        PREDICT_BATCH so the single-record path keeps its pre-plane shape.
+        Only the rows dirtied by the previous use are re-zeroed; the
+        caller overwrites rows ``[0, n)``. Consumers (predict dispatch,
+        Cohort.predict_rows) copy before returning, so reuse across
+        forecasts is safe."""
+        b = PREDICT_BATCH
+        while b < n:
+            b <<= 1
+        if self.sparse:
+            if self._scratch is None or self._scratch[0].shape[0] < b:
+                self._scratch = (
+                    np.zeros((b, self.max_nnz), np.int32),
+                    np.zeros((b, self.max_nnz), np.float32),
+                )
+                self.scratch_allocs += 1
+                self._scratch_dirty = 0
+            ib, vb = self._scratch
+            if self._scratch_dirty:
+                ib[: self._scratch_dirty] = 0
+                vb[: self._scratch_dirty] = 0.0
+            self._scratch_dirty = n
+            return ib[:b], vb[:b]
+        if self._scratch is None or self._scratch.shape[0] < b:
+            self._scratch = np.zeros((b, self.dim), np.float32)
+            self.scratch_allocs += 1
+            self._scratch_dirty = 0
+        if self._scratch_dirty:
+            self._scratch[: self._scratch_dirty] = 0.0
+        self._scratch_dirty = n
+        return self._scratch[:b]
+
+    def gang_predict_ok(self) -> bool:
+        """Gang forecast serving bypasses ``node.on_forecast_batch`` with a
+        bit-identical batched predict — only valid for attached dense nets
+        whose node keeps the base (predict-with-local-model) behavior."""
+        return (
+            not self.sparse
+            and self.pipeline._cohort is not None
+            and type(self.node).on_forecast_batch
+            is WorkerNode.on_forecast_batch
+        )
+
     def flush_batch(self) -> None:
+        if (
+            self.serving is not None
+            and self.serve_queue.entries
+            and len(self.batcher)
+        ):
+            # this net's model is about to change (the pending rows will
+            # stage/dispatch a fit): exact-mode serving drains the queue
+            # NOW with the pre-fit params — the bit-identity trigger;
+            # relaxed mode counts the chunk (runtime/serving.py)
+            self._plane.fence(self)
         if self.pipeline._cohort is not None:
             # a deferred sync point may set `waiting`; settle before the
             # view-vs-copy decision or a blocking node could buffer VIEWS
@@ -276,7 +354,12 @@ class Spoke:
         emit_prediction: Callable[[Prediction], None],
         emit_response: Callable[[QueryResponse], None],
         on_poll: Callable[[], None],
-        note_wire: Optional[Callable[[int, int, str, int], None]] = None,
+        # (network_id, hub_id, counter, value) — value is an int for the
+        # additive counters, a (p50, p99, p999) triple for serve_latency_ms
+        note_wire: Optional[Callable[[int, int, str, Any], None]] = None,
+        emit_predictions: Optional[
+            Callable[[List[Prediction]], None]
+        ] = None,
     ):
         self.worker_id = worker_id
         self.config = config
@@ -294,6 +377,7 @@ class Spoke:
         )
         self._send_to_hub = send_to_hub
         self._emit_prediction = emit_prediction
+        self._emit_predictions = emit_predictions
         self._emit_response = emit_response
         self._on_poll = on_poll
         # spoke-side reliable-channel events (duplicates dropped, gaps
@@ -304,6 +388,11 @@ class Spoke:
         # the per-event guard walk is gated on this one flag so unarmed
         # jobs pay a single attribute read on the data path
         self._any_guard = False
+        # adaptive-batching serving plane (runtime/serving.py): created on
+        # the first serving-armed net; the flag gates every hot-path hook
+        # so serving-unset jobs pay one attribute read
+        self.serving_plane: Optional[ServingPlane] = None
+        self._any_serving = False
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
@@ -338,6 +427,8 @@ class Spoke:
         )
         self.nets[request.id] = net
         net.node.on_start()
+        if net.serving is not None:
+            net._plane = self._ensure_serving_plane()
         if net.pipeline.guard is not None:
             self._any_guard = True
             # seed the first last-known-good snapshot at the init params:
@@ -364,8 +455,34 @@ class Spoke:
             for _op, block, _t, _i in self._packed_buffer.drain():
                 self.handle_packed(*block)
 
+    def _ensure_serving_plane(self) -> ServingPlane:
+        if self.serving_plane is None:
+            self.serving_plane = ServingPlane(
+                self._emit_prediction,
+                emit_predictions=self._emit_predictions,
+            )
+        self._any_serving = True
+        return self.serving_plane
+
+    def poll_serving(self) -> None:
+        """Serving-plane boundary tick: fill-triggered flushes (aligned so
+        same-cohort queues gang) and the maxDelayMs deadline clock. Runs
+        after every data event and from the live loop's silence check;
+        one flag read when no hosted net is serving-armed."""
+        if self._any_serving:
+            self.serving_plane.maybe_fill_flush()
+            self.serving_plane.poll()
+
     def _delete(self, network_id: int) -> None:
         net = self.nets.pop(network_id, None)
+        if (
+            net is not None
+            and net.serving is not None
+            and net.serve_queue.entries
+        ):
+            # pending forecasts serve through the departing model first —
+            # the per-record path would have answered them already
+            self.serving_plane.flush_net(net)
         if net is not None and self.cohorts is not None:
             # cohort churn: the member's slot frees for reuse (compaction),
             # no recompile; survivors keep their slots untouched
@@ -422,6 +539,8 @@ class Spoke:
         self._flush_cohorts()
         # guard: evaluate the health results this record's launches noted
         self._guard_tick_all()
+        # serving plane: fill-aligned flushes + the maxDelayMs deadline
+        self.poll_serving()
         if inst.operation != FORECASTING:
             # poll marker every 100 training records — once per record, not
             # per hosted pipeline (FlinkSpoke.scala:83-89)
@@ -474,6 +593,7 @@ class Spoke:
             self._process_packed_gang(gang_nets, x, y, f_idx)
         self._flush_cohorts()
         self._guard_tick_all()
+        self.poll_serving()
         nt = n - int(f_idx.size)
         if nt:
             pc = self._poll_counter
@@ -573,6 +693,9 @@ class Spoke:
     def _serve_packed(
         self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
     ) -> None:
+        if net.serving is not None:
+            self._queue_packed(net, x, f_idx)
+            return
         if net.sparse:
             sidx, sval = self._dense_rows_to_coo(x[f_idx], net.max_nnz)
             for j in range(f_idx.size):
@@ -585,7 +708,8 @@ class Spoke:
         rows = self._adapt_width(x[f_idx], net.dim)
         for s in range(0, f_idx.size, PREDICT_BATCH):
             chunk = rows[s : s + PREDICT_BATCH]
-            xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+            t0 = time.perf_counter()
+            xb = net.predict_pad(chunk.shape[0])
             xb[: chunk.shape[0]] = chunk
             preds = net.node.on_forecast_batch(xb)
             for j in range(chunk.shape[0]):
@@ -596,6 +720,29 @@ class Spoke:
                 self._emit_prediction(
                     Prediction(net.request.id, inst, float(preds[j]))
                 )
+            lat = (time.perf_counter() - t0) * 1000.0
+            for _ in range(chunk.shape[0]):
+                net.serve_stats.note(lat)
+
+    def _queue_packed(
+        self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
+    ) -> None:
+        """Admit packed-route forecast rows into the net's serving queue.
+        Dense rows defer DataInstance construction to emission; sparse
+        rows carry it (the payload features are the pre-COO dense row)."""
+        plane = self.serving_plane
+        if net.sparse:
+            sidx, sval = self._dense_rows_to_coo(x[f_idx], net.max_nnz)
+            for j in range(f_idx.size):
+                inst = DataInstance(
+                    numerical_features=x[int(f_idx[j])].tolist(),
+                    operation=FORECASTING,
+                )
+                plane.admit(net, inst, (sidx[j], sval[j]))
+            return
+        rows = self._adapt_width(x[f_idx], net.dim)
+        for j in range(rows.shape[0]):
+            plane.admit(net, None, rows[j])
 
     def _train(self, net: SpokeNet, x, y: float) -> None:
         # float32 boundary clamp for the target, matching the packed/C
@@ -620,18 +767,19 @@ class Spoke:
             net.flush_batch()
 
     def _serve(self, net: SpokeNet, inst: DataInstance, x) -> None:
+        t0 = time.perf_counter()
         if net.sparse:
-            ib = np.zeros((PREDICT_BATCH, net.max_nnz), np.int32)
-            vb = np.zeros((PREDICT_BATCH, net.max_nnz), np.float32)
+            ib, vb = net.predict_pad(1)
             ib[0], vb[0] = x
             xb = (ib, vb)
         else:
-            xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+            xb = net.predict_pad(1)
             xb[0] = x
         preds = net.node.on_forecast_batch(xb)
         self._emit_prediction(
             Prediction(net.request.id, inst, float(preds[0]))
         )
+        net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
 
     # --- query / termination (FlinkSpoke.scala:136-171) ---
 
@@ -649,6 +797,10 @@ class Spoke:
         response protocol of FlinkNetwork.sendQueryResponse
         (FlinkNetwork.scala:48-149,151-240). The ResponseMerger re-assembles
         buckets and averages metrics across workers."""
+        if net.serving is not None and net.serve_queue.entries:
+            # pending forecasts emit BEFORE the response, as the
+            # per-record path would have
+            self.serving_plane.flush_net(net)
         net.flush_batch()
         self._flush_cohorts()
         # settle any pending guard trip BEFORE evaluating: a query must
@@ -667,6 +819,18 @@ class Spoke:
                 net.request.id, 0, "program_launches", net.program_launches
             )
             net.program_launches = 0
+        # serving telemetry rides the same fold: the served count is a
+        # plain counter, the latency percentiles a (p50, p99, p999) triple
+        # the job routes to Statistics.note_serve_latency
+        if self._note_wire is not None and net.serve_stats.count:
+            self._note_wire(
+                net.request.id, 0, "forecasts_served", net.serve_stats.count
+            )
+            self._note_wire(
+                net.request.id, 0, "serve_latency_ms",
+                net.serve_stats.percentiles(),
+            )
+            net.serve_stats.reset()
         desc = net.pipeline.describe()
         qstats = net.node.query_stats()
 
@@ -753,6 +917,11 @@ class Spoke:
     def _deliver_from_hub(
         self, net: SpokeNet, network_id: int, hub_id: int, op: str, payload: Any
     ) -> None:
+        if net.serving is not None and net.serve_queue.entries:
+            # a hub payload may replace this net's model wholesale (round
+            # release, broadcast, resync): exact-mode serving drains the
+            # queue with the pre-replacement params first
+            self.serving_plane.fence(net)
         # deliver() is the worker-side decode boundary (transport codec)
         net.node.deliver(op, payload, hub_id)
         # cooperative multi-pipeline fairness: every hub RPC for one net
@@ -787,7 +956,10 @@ class Spoke:
     def _process_packed_for_net(self, net, x, y, f_idx) -> None:
         """One net's share of a packed block: serve each forecast at its
         stream position (train the rows before it first), matching
-        per-record ordering."""
+        per-record ordering. Serving-armed dense nets take the bulk
+        span-admission walker instead of the per-position loop."""
+        if self._process_packed_serving_bulk([net], x, y, f_idx):
+            return
         n = x.shape[0]
         prev = 0
         for f in f_idx:
@@ -795,6 +967,8 @@ class Spoke:
             if f > prev:
                 self._train_packed(net, x[prev:f], y[prev:f])
             self._serve_packed(net, x, np.asarray([f]))
+            if self._any_serving:
+                self.serving_plane.maybe_fill_flush()
             prev = f + 1
         if prev < n:
             self._train_packed(net, x[prev:], y[prev:])
@@ -843,6 +1017,10 @@ class Spoke:
         net.pipeline.guard.rollback(net.pipeline)
         if self._note_wire is not None:
             self._note_wire(nid, 0, "rollbacks_performed", 1)
+        if net.serving is not None and net.serve_queue.entries:
+            # queued forecasts flush through the ROLLED-BACK (last-known-
+            # good) model — never through the params the guard condemned
+            self.serving_plane.flush_net(net)
         if net.node.codec is not None:
             # the rollback replaced the model wholesale AND corrupt state
             # may already have shipped: EF residuals and topk tx bases are
@@ -863,6 +1041,8 @@ class Spoke:
         """Lockstep twin of ``_process_packed_for_net`` over ALL nets:
         segments between forecasts gang-train, forecasts gang-serve at
         their stream position."""
+        if self._process_packed_serving_bulk(nets, x, y, f_idx):
+            return
         n = x.shape[0]
         prev = 0
         for f in f_idx:
@@ -873,6 +1053,74 @@ class Spoke:
             prev = f + 1
         if prev < n:
             self._train_packed_gang(nets, x[prev:], y[prev:])
+
+    def _process_packed_serving_bulk(self, nets, x, y, f_idx) -> bool:
+        """Serving-plane fast path for a packed block: when EVERY net is
+        dense and serving-armed (equal batch size and fill — lockstep),
+        the per-position serve loop collapses into span-wise bulk
+        admission between batcher-fill boundaries.
+
+        Exactness argument: a queued forecast's answer only depends on the
+        params at its flush, and the fence flushes queues before any fit
+        dispatches — so admission order relative to the TRAINING rows
+        between two fills is immaterial. The walker feeds training rows in
+        fill-sized chunks and, before each chunk, admits every forecast
+        positioned before the row that would complete the fill: any fence
+        the chunk triggers then flushes exactly the forecasts the
+        per-record path would have served pre-fit. (With holdout sampling
+        the real fill lands at or after the chunk end — the bound is
+        conservative, never early.) Returns False when ineligible; the
+        caller falls back to the per-position loop."""
+        if f_idx.size == 0 or not nets:
+            return False
+        b0 = nets[0].batcher.batch_size
+        fill0 = len(nets[0].batcher)
+        for net in nets:
+            if (
+                net.serving is None
+                or net.sparse
+                or net.batcher.batch_size != b0
+                or len(net.batcher) != fill0
+            ):
+                return False
+        n = x.shape[0]
+        plane = self.serving_plane
+        t_mask = np.ones((n,), bool)
+        t_mask[f_idx] = False
+        t_idx = np.nonzero(t_mask)[0]
+        rows_cache: Dict[int, np.ndarray] = {}
+
+        def admit(lo: int, hi: int) -> None:
+            # one enqueue clock per span (every row of the span becomes
+            # servable at this moment), then flush right away if a queue
+            # filled — flushing EARLIER than the fence is always
+            # exact-safe, and it keeps enqueue->emit latency at span
+            # granularity instead of training-chunk granularity
+            now = plane._clock()
+            for net in nets:
+                rows = rows_cache.get(net.dim)
+                if rows is None:
+                    rows = rows_cache[net.dim] = self._adapt_width(
+                        x[f_idx], net.dim
+                    )
+                plane.admit_rows(net, rows[lo:hi], now)
+            plane.maybe_fill_flush()
+
+        fi = 0  # forecasts admitted so far (index into f_idx)
+        ti = 0  # training rows fed so far (index into t_idx)
+        while ti < t_idx.size:
+            room = max(b0 - len(nets[0].batcher), 1)
+            chunk = t_idx[ti : ti + room]
+            ti += chunk.size
+            bound = int(chunk[-1])
+            hi = fi + int(np.searchsorted(f_idx[fi:], bound))
+            if hi > fi:
+                admit(fi, hi)
+                fi = hi
+            self._train_packed_gang(nets, x[chunk], y[chunk])
+        if fi < f_idx.size:
+            admit(fi, f_idx.size)
+        return True
 
     def _train_packed_gang(
         self, nets: List[SpokeNet], tx: np.ndarray, ty: np.ndarray
@@ -956,6 +1204,12 @@ class Spoke:
         while i < total:
             i += batcher.add_many(tx[i:], ty[i:])
             if batcher.full:
+                for net in members:
+                    # every member's model is about to change: exact-mode
+                    # serving drains each queue first (same fence the
+                    # per-member flush_batch applies)
+                    if net.serving is not None and net.serve_queue.entries:
+                        self.serving_plane.fence(net)
                 flushed = batcher.flush_views()
                 x, y, m = flushed
                 for net in members:
@@ -970,17 +1224,6 @@ class Spoke:
                         net.node.on_training_batch(x, y, m)
         for net in members[1:]:
             net.batcher.clone_pending_from(batcher)
-
-    def _gang_predict_ok(self, net: SpokeNet) -> bool:
-        """Gang forecast serving bypasses ``node.on_forecast_batch`` with a
-        bit-identical batched predict — only valid for attached dense nets
-        whose node keeps the base (predict-with-local-model) behavior."""
-        return (
-            not net.sparse
-            and net.pipeline._cohort is not None
-            and type(net.node).on_forecast_batch
-            is WorkerNode.on_forecast_batch
-        )
 
     def _gang_predictions(
         self, entries: List[Tuple[SpokeNet, np.ndarray]]
@@ -1002,15 +1245,22 @@ class Spoke:
 
     def _serve_many(self, inst: DataInstance, entries) -> None:
         """Serve one forecast record to many nets, ganging cohort members
-        through one predict launch; emission keeps the nets order."""
+        through one predict launch; emission keeps the nets order.
+        Serving-armed nets queue instead (runtime/serving.py) and flush at
+        the record boundary below when a queue filled."""
         gang_in = []
+        t0 = time.perf_counter()
         for net, x in entries:
-            if self._gang_predict_ok(net):
-                xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+            if net.serving is not None:
+                self.serving_plane.admit(net, inst, x)
+            elif net.gang_predict_ok():
+                xb = net.predict_pad(1)
                 xb[0] = x
                 gang_in.append((net, xb))
         ganged = self._gang_predictions(gang_in) if gang_in else {}
         for net, x in entries:
+            if net.serving is not None:
+                continue
             pred = ganged.get(id(net))
             if pred is None:
                 self._serve(net, inst, x)
@@ -1018,19 +1268,28 @@ class Spoke:
                 self._emit_prediction(
                     Prediction(net.request.id, inst, pred)
                 )
+                net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
+        if self._any_serving:
+            self.serving_plane.maybe_fill_flush()
 
     def _serve_packed_gang(self, nets: List[SpokeNet], x: np.ndarray, f: int) -> None:
         """Serve packed-row forecast ``f`` to every net at its stream
-        position (gang predict for cohort members, solo path otherwise)."""
+        position (gang predict for cohort members, the solo path
+        otherwise, the serving queue for armed nets)."""
         gang_in = []
+        t0 = time.perf_counter()
         for net in nets:
-            if self._gang_predict_ok(net):
+            if net.serving is not None:
+                self._queue_packed(net, x, np.asarray([f]))
+            elif net.gang_predict_ok():
                 row = self._adapt_width(x[f : f + 1], net.dim)[0]
-                xb = np.zeros((PREDICT_BATCH, net.dim), np.float32)
+                xb = net.predict_pad(1)
                 xb[0] = row
                 gang_in.append((net, xb))
         ganged = self._gang_predictions(gang_in) if gang_in else {}
         for net in nets:
+            if net.serving is not None:
+                continue
             pred = ganged.get(id(net))
             if pred is None:
                 self._serve_packed(net, x, np.asarray([f]))
@@ -1043,6 +1302,9 @@ class Spoke:
                 self._emit_prediction(
                     Prediction(net.request.id, inst, pred)
                 )
+                net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
+        if self._any_serving:
+            self.serving_plane.maybe_fill_flush()
 
     def _drain_pause_buffer(self, net: SpokeNet) -> None:
         if net.pause_buffer.is_empty:
@@ -1054,9 +1316,14 @@ class Spoke:
                     net, px, py, np.nonzero(pop != 0)[0]
                 )
             elif operation == FORECASTING:
-                self._serve(net, inst, x)
+                if net.serving is not None:
+                    self.serving_plane.admit(net, inst, x)
+                else:
+                    self._serve(net, inst, x)
             else:
                 self._train(net, x, 0.0 if target is None else target)
+        if self._any_serving:
+            self.serving_plane.maybe_fill_flush()
 
     # --- live rescale (FlinkSpoke.scala:345-348, SpokeLogic.scala:37-50) ---
 
@@ -1072,6 +1339,14 @@ class Spoke:
         pre-creation buffers concatenate — the mergingDataBuffers +
         wrapper-merge semantics of the reference's rescale path
         (SpokeLogic.scala:37-50, FlinkSpoke.scala:289-330)."""
+        # pending forecasts on BOTH sides serve before any model merges:
+        # the retiring replicas' models are about to disappear and the
+        # survivors' are about to change (a rescale forces a serving
+        # flush in every staleness mode)
+        if retired.serving_plane is not None:
+            retired.serving_plane.flush_all()
+        if self.serving_plane is not None:
+            self.serving_plane.flush_all()
         # settle gang state on both sides first: the retiring spoke's
         # cohorts dissolve (members get their state back for the merge);
         # survivors keep their cohorts — merge_from edits flow through the
@@ -1088,6 +1363,10 @@ class Spoke:
                 self.nets[net_id] = rnet
                 if rnet.pipeline.guard is not None:
                     self._any_guard = True
+                if rnet.serving is not None:
+                    # re-home the queue plumbing: the retired spoke's plane
+                    # (already flushed above) is gone with its owner
+                    rnet._plane = self._ensure_serving_plane()
                 continue
             snet.shared_taint = True
             # pending rows train into the surviving replica: the batcher's
