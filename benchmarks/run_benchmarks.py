@@ -1166,8 +1166,11 @@ def main():
              # the results JSON, not just in CI
              "--chaos", "default",
              # multi-tenant sweep: per-tenant + aggregate ex/s for N
-             # co-hosted same-spec pipelines, per-pipeline dispatch vs
-             # cohort gang dispatch, with programLaunches per run
+             # co-hosted same-spec pipelines — per-pipeline dispatch vs
+             # cohort gang dispatch vs DEVICE-SHARDED cohort dispatch
+             # (tenant axis across the local mesh), with programLaunches
+             # plus the device count and per-shard tenant placement per
+             # run so BENCH rounds attribute throughput to mesh width
              "--pipelines", "1,8,64,256",
              # forecast-heavy serving sweep (benchmarks/streams.py): the
              # run_benchmarks legs are otherwise training-dominated, so

@@ -56,6 +56,11 @@ class Statistics:
     # so the cross-pipeline SUM equals real program launches); counted
     # spoke-side and folded in at query/terminate time
     program_launches: int = 0
+    # tenant-mesh width GAUGE (JobConfig.cohort_shards): the device shard
+    # count the pipeline's cohort launches ran across — 0 when sharding
+    # is off/never engaged, max-combined (not summed) across contributors
+    # so BENCH rounds can attribute throughput to mesh width
+    cohort_shards: int = 0
     # model-integrity guard counters (zero with trainingConfiguration.guard
     # unset, the default): worker updates the hub-side admission boundary
     # rejected before round accounting (non-finite / norm-exploded),
@@ -102,8 +107,10 @@ class Statistics:
         members_evicted: int = 0,
         records_quarantined: int = 0,
         forecasts_served: int = 0,
+        cohort_shards: int = 0,
     ) -> None:
-        """Accumulate communication counters (FlinkHub.scala:118-127)."""
+        """Accumulate communication counters (FlinkHub.scala:118-127).
+        ``cohort_shards`` is a gauge: max-combined, not summed."""
         self.models_shipped += models_shipped
         self.bytes_shipped += bytes_shipped
         self.num_of_blocks += num_of_blocks
@@ -117,6 +124,7 @@ class Statistics:
         self.members_evicted += members_evicted
         self.records_quarantined += records_quarantined
         self.forecasts_served += forecasts_served
+        self.cohort_shards = max(self.cohort_shards, cohort_shards)
 
     def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
         """Fold one contributor's serving-latency percentile window in
@@ -171,6 +179,7 @@ class Statistics:
             gaps_resynced=self.gaps_resynced + other.gaps_resynced,
             quorum_releases=self.quorum_releases + other.quorum_releases,
             program_launches=self.program_launches + other.program_launches,
+            cohort_shards=max(self.cohort_shards, other.cohort_shards),
             deltas_rejected=self.deltas_rejected + other.deltas_rejected,
             rollbacks_performed=self.rollbacks_performed
             + other.rollbacks_performed,
@@ -211,6 +220,7 @@ class Statistics:
             "gapsResynced": self.gaps_resynced,
             "quorumReleases": self.quorum_releases,
             "programLaunches": self.program_launches,
+            "cohortShards": self.cohort_shards,
             "deltasRejected": self.deltas_rejected,
             "rollbacksPerformed": self.rollbacks_performed,
             "membersEvicted": self.members_evicted,

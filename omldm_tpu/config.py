@@ -104,6 +104,16 @@ class JobConfig:
     # on parallel backends, ~1e-9 batched-reduction drift), or "auto"
     # (map on CPU, vmap elsewhere).
     cohort_impl: str = "auto"
+    # device sharding of the cohort tenant axis (runtime.cohort): "off"
+    # (default — every gang launch runs on one device, the exact
+    # pre-sharding path), "auto" (lay the cohort's leading pipeline axis
+    # across the largest power-of-two slice of the local mesh), or an
+    # integer shard count (clamped to the local device count, floored to
+    # a power of two). With S > 1 shards, members balance across shards,
+    # capacity buckets are per-shard, and fit / gang predict / flat
+    # params / guard health all run as ONE shard_map launch over a
+    # "tenants" mesh axis with per-shard lax.map member iteration.
+    cohort_shards: str = "off"
     # Hub liveness walk stride on the record path: with quorum/timeout
     # armed, the per-record check_liveness walk runs every N events (or on
     # a deadline), not per record (runtime/hub.py).

@@ -91,6 +91,24 @@ def guard_config(tc) -> Optional[GuardConfig]:
     )
 
 
+def gang_health_values(sq_norms) -> np.ndarray:
+    """Materialize a gang launch's ``[C]`` member-health vector to host.
+
+    For device-sharded cohorts the vector is laid across the tenant mesh
+    axis; ``jax.device_get`` fetches every shard's slice in one parallel
+    per-shard transfer (instead of a serial gather through one device)
+    and the host assembles the full vector. Plain arrays (single-device
+    cohorts, tests passing numpy) fall through to ``np.asarray``."""
+    if isinstance(sq_norms, np.ndarray):
+        return sq_norms
+    try:
+        import jax
+
+        return np.asarray(jax.device_get(sq_norms))
+    except Exception:
+        return np.asarray(sq_norms)
+
+
 def _payload_vector(payload: Any) -> Optional[np.ndarray]:
     """The model/delta vector a worker message carries, if any. Worker
     pushes ship flat float vectors under ``params`` (all six parameter

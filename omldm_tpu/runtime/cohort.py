@@ -44,6 +44,26 @@ The engine is armed by ``JobConfig.cohort``: ``"off"`` (every route is the
 exact pre-cohort code path), ``"auto"`` (cohorts form once
 ``cohort_min`` homogeneous pipelines are live on a spoke — the default), or
 ``"on"`` (every eligible pipeline cohorts immediately, capacity 1 up).
+
+**Device sharding** (``JobConfig.cohort_shards``): the tenant axis is
+embarrassingly parallel, so with S > 1 shards the cohort lays its leading
+pipeline axis across the first S local devices as a ``"tenants"`` mesh axis
+(``shard_map`` through the ``utils.jaxcompat`` shim — the same portability
+layer the SPMD engine rides) and every gang program — fit, shared-input
+fit, gang predict (forecast serving flushes), flat params, and the guard's
+fused health vector — runs as ONE sharded launch with the per-shard member
+iteration unchanged (``lax.map``/``vmap`` over the shard's local block).
+Because members are independent, the per-member math is the SAME program
+the single-device cohort runs: shard count 1 is the exact pre-sharding
+code path, and sharded execution is bit-identical to it on CPU (pinned by
+tests/test_cohort_sharded.py). Slots map to shards in contiguous blocks
+(slot s lives on shard ``s // (capacity // S)``), capacity stays a
+multiple of S (initial capacity S, doubling growth), Create/Update/Delete
+churn compacts into the least-loaded shard's lowest free slot (no shape
+change => no recompile, and tenants stay balanced across the mesh), and
+the staging buffers transfer per-shard — each device receives its own
+contiguous block slice instead of the whole gang input funneling through
+one device.
 """
 
 from __future__ import annotations
@@ -56,25 +76,69 @@ import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 
+from omldm_tpu.guard import gang_health_values
 from omldm_tpu.pipelines.pipeline import (
     _LRU_CAP,
     _LRUCache,
     _build_impls,
     _param_health,
 )
+from omldm_tpu.utils.jaxcompat import shard_map as _shard_map
 
 # staged batches per member before a launch is forced: bounds the gang input
 # tensor [capacity, T, B, D] when a pipeline has no sync point for a while
 MAX_STAGE_DEPTH = 32
 
-# gang program cache: (pipeline cache key, use_vmap) -> jitted callables.
-# Shape specialization inside jit handles the (capacity, T) buckets; this
-# cache only bounds the number of traced python callables, like _JIT_CACHE.
+# gang program cache: (pipeline cache key, use_vmap, n_shards) -> jitted
+# callables. Shape specialization inside jit handles the (capacity, T)
+# buckets; this cache only bounds the number of traced python callables,
+# like _JIT_CACHE.
 _GANG_CACHE: _LRUCache = _LRUCache(_LRU_CAP)
+
+# one Mesh per shard count, shared by every cohort at that width (the
+# cached gang programs close over it, so cohorts built later must see the
+# SAME mesh object their cached programs were traced against)
+_MESHES: Dict[int, Any] = {}
 
 
 def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
+
+
+def resolve_cohort_shards(config) -> int:
+    """The effective tenant-axis shard count for ``config.cohort_shards``:
+    ``off``/empty/<=1 -> 1 (single-device gang launches, the exact
+    pre-sharding path), ``auto`` -> the largest power of two <= the local
+    device count, an integer -> clamped to the local device count and
+    floored to a power of two (capacity buckets double from S, so a pow2
+    S keeps them pow2)."""
+    spec = str(getattr(config, "cohort_shards", "off") or "off").strip().lower()
+    if spec in ("off", "none", "false", "0", "1", ""):
+        return 1
+    n_dev = len(jax.local_devices())
+    if spec == "auto":
+        want = n_dev
+    else:
+        try:
+            want = int(spec)
+        except ValueError:
+            # unrecognized spelling: degrade to single-device like the
+            # sibling cohort/cohort_impl knobs, never kill the job
+            return 1
+    want = min(max(want, 1), n_dev)
+    n = 1
+    while n * 2 <= want:
+        n *= 2
+    return n
+
+
+def _mesh_for(n_shards: int):
+    mesh = _MESHES.get(n_shards)
+    if mesh is None:
+        devices = np.array(jax.local_devices()[:n_shards])
+        mesh = jax.sharding.Mesh(devices, ("tenants",))
+        _MESHES[n_shards] = mesh
+    return mesh
 
 
 def _tree_map(f, *trees):
@@ -82,7 +146,8 @@ def _tree_map(f, *trees):
 
 
 def _build_gang_programs(
-    learner, preps, per_record: bool, use_vmap: bool, guarded: bool = False
+    learner, preps, per_record: bool, use_vmap: bool, guarded: bool = False,
+    mesh=None,
 ):
     """The (fit, shared-input fit, predict, flat) jitted programs for a
     cohort spec.
@@ -92,7 +157,15 @@ def _build_gang_programs(
     ``guarded`` cohorts additionally reduce each member's post-scan
     parameter health (isfinite + squared norm) inside the SAME launch —
     the per-member half of the model-integrity guard, detecting one
-    diverging member without extra dispatches or perturbing siblings."""
+    diverging member without extra dispatches or perturbing siblings.
+
+    With ``mesh`` set (device-sharded cohorts), every program wraps in
+    ``shard_map`` over the ``tenants`` axis before jit: each shard runs
+    the per-member iteration over ITS contiguous block of the leading
+    pipeline axis — members are independent, so no collective is needed
+    and the per-member math is bitwise the single-device program's. The
+    shared-input twin keeps its batches replicated (shipped once) and
+    broadcasts per shard; everything else shards on the leading axis."""
     fit_impl, predict_impl, _eval_impl, _ = _build_impls(
         learner, preps, per_record
     )
@@ -149,6 +222,39 @@ def _build_gang_programs(
             act, jnp.broadcast_to(ms, (cap,) + ms.shape), 0.0
         )
         return gang_fit(state, xs_b, ys_b, ms_b)
+
+    if mesh is not None:
+        # device-sharded gang: one launch, the tenants axis laid across
+        # the mesh, per-shard member iteration. in/out specs are pytree
+        # PREFIXES — P("tenants") shards every leaf's leading (pipeline)
+        # axis; P() replicates the shared-input batches so they ship once
+        # and broadcast in-program on each shard. The wraps bind NEW
+        # names: gang_fit_shared calls gang_fit late-bound, and wrapping
+        # it in place would nest shard_maps.
+        P = jax.sharding.PartitionSpec
+        sh, rep = P("tenants"), P()
+        sharded_fit = _shard_map(
+            gang_fit, mesh=mesh, in_specs=(sh, sh, sh, sh), out_specs=sh,
+            check_vma=False,
+        )
+        sharded_shared = _shard_map(
+            gang_fit_shared, mesh=mesh, in_specs=(sh, sh, rep, rep, rep),
+            out_specs=sh, check_vma=False,
+        )
+        sharded_predict = _shard_map(
+            gang_predict, mesh=mesh, in_specs=(sh, sh), out_specs=sh,
+            check_vma=False,
+        )
+        sharded_flat = _shard_map(
+            gang_flat, mesh=mesh, in_specs=sh, out_specs=sh,
+            check_vma=False,
+        )
+        return (
+            jax.jit(sharded_fit, donate_argnums=0),
+            jax.jit(sharded_shared, donate_argnums=0),
+            jax.jit(sharded_predict),
+            jax.jit(sharded_flat),
+        )
 
     return (
         jax.jit(gang_fit, donate_argnums=0),
@@ -219,20 +325,37 @@ class Cohort:
     a power of two; churn reuses freed slots (compaction) and only a full
     cohort doubles capacity (a shape change XLA re-specializes once)."""
 
-    def __init__(self, pipeline, use_vmap: bool, timer=None):
+    def __init__(self, pipeline, use_vmap: bool, timer=None, n_shards: int = 1,
+                 serve_timer=None):
         self.key = pipeline.cache_key
         self.use_vmap = use_vmap
         self.timer = timer
+        # serving-launch timing (gang predict flushes) is accounted apart
+        # from the fit flush path so launch_timing() can report both
+        self.serve_timer = serve_timer
+        # tenant-axis device sharding: with n_shards > 1 the stacked state
+        # and every gang launch lay the leading pipeline axis across the
+        # first n_shards local devices (mesh axis "tenants"); 1 = the
+        # exact single-device pre-sharding path
+        self.n_shards = max(int(n_shards), 1)
+        self._mesh = _mesh_for(self.n_shards) if self.n_shards > 1 else None
+        self._sharding = (
+            jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec("tenants")
+            )
+            if self._mesh is not None
+            else None
+        )
         # guarded pipelines gang with guarded programs (the guard flag is
         # part of cache_key, so a cohort is uniformly guarded or not)
         self.guarded = pipeline.guard is not None
-        programs = _GANG_CACHE.get((self.key, use_vmap))
+        programs = _GANG_CACHE.get((self.key, use_vmap, self.n_shards))
         if programs is None:
             programs = _build_gang_programs(
                 pipeline.learner, pipeline.preps, pipeline.per_record,
-                use_vmap, guarded=self.guarded,
+                use_vmap, guarded=self.guarded, mesh=self._mesh,
             )
-            _GANG_CACHE.put((self.key, use_vmap), programs)
+            _GANG_CACHE.put((self.key, use_vmap, self.n_shards), programs)
         self._gfit, self._gfit_shared, self._gpred, self._gflat = programs
         flat0, self._unravel = jax.flatten_util.ravel_pytree(
             pipeline._state["params"]
@@ -280,6 +403,77 @@ class Cohort:
         self._pred_dirty: Dict[tuple, List[int]] = {}
         self.attach(pipeline)
 
+    # --- tenant-axis sharding helpers ------------------------------------
+
+    def _pin(self, tree):
+        """Constrain a stacked pytree to the tenants sharding. Host writes
+        and growth run as plain jnp ops whose output placement GSPMD
+        chooses; this re-lays every leaf's leading axis across the mesh
+        (a no-op copy when already correctly sharded). Identity when
+        unsharded."""
+        if self._sharding is None:
+            return tree
+        return jax.device_put(tree, self._sharding)
+
+    def _stage_dev(self, host_view: np.ndarray):
+        """Ship one staged gang input to the device(s). Unsharded: hand
+        the numpy view to the dispatch (which copies to the one device).
+        Sharded: transfer per shard — slots are laid out in contiguous
+        shard blocks, so each device receives its own slice of the host
+        buffer and the transfer fans out across the mesh instead of
+        funneling the whole ``[C, T, B, ...]`` tensor through one
+        device."""
+        if self._sharding is None:
+            return host_view
+        return jax.device_put(host_view, self._sharding)
+
+    def _member_pull(self, slot: int) -> dict:
+        """One member's state slice out of the stacked tree. Sharded
+        cohorts materialize it to HOST leaves: a slice stays committed to
+        its owning mesh device, and downstream per-member ops (solo
+        re-dispatch after detach, merge_from, checkpoint restore) would
+        trip multi-device colocation checks mixing it with default-device
+        arrays. Values are bitwise the device slice either way."""
+        st = _tree_map(lambda l: l[slot], self.stacked)
+        if self._sharding is not None:
+            st = _tree_map(lambda l: np.asarray(l), st)
+        return st
+
+    def _host_state_leaves(self, state):
+        """Scatter-side twin of :meth:`_member_pull`: writes into a
+        sharded stack go in as host numpy leaves (uncommitted), never as
+        arrays pinned to some other device."""
+        if self._sharding is None:
+            return state
+        return _tree_map(lambda v: np.asarray(v), state)
+
+    def _shard_of(self, slot: int) -> int:
+        per = max(self.capacity // self.n_shards, 1)
+        return min(slot // per, self.n_shards - 1)
+
+    def shard_placement(self) -> List[int]:
+        """Active member count per shard (length ``n_shards``) — the
+        tenant placement the multi-tenant sweep records per mesh width."""
+        counts = [0] * self.n_shards
+        for slot, member in enumerate(self.members):
+            if member is not None:
+                counts[self._shard_of(slot)] += 1
+        return counts
+
+    def _pick_slot(self) -> int:
+        """Claim a free slot. Single-shard: the lowest free slot (churn
+        compaction). Sharded: the lowest free slot on the least-loaded
+        shard — churn still compacts (within a shard, so no shape change
+        and no recompile) while members stay balanced across the mesh."""
+        if self.n_shards == 1:
+            return self._free.pop()
+        counts = self.shard_placement()
+        slot = min(
+            self._free, key=lambda s: (counts[self._shard_of(s)], s)
+        )
+        self._free.remove(slot)
+        return slot
+
     # --- membership ------------------------------------------------------
 
     def attach(self, pipeline) -> int:
@@ -287,13 +481,27 @@ class Cohort:
         and the pipeline's hot-path methods route through the cohort."""
         self.launch()
         if self.stacked is None:
-            # first member: capacity-1 stack seeded from its state
-            self.capacity = 1
-            self.members = [pipeline]
+            # first member: the smallest stack seeded from its state —
+            # capacity 1 unsharded, one slot per shard when sharded (the
+            # leading axis must cover the mesh; the duplicate rows are
+            # inert until attach seeds them)
+            cap = self.n_shards
+            self.capacity = cap
+            self.members = [pipeline] + [None] * (cap - 1)
             self.n_active = 1
-            self.stacked = _tree_map(
-                lambda l: jnp.asarray(l)[None], pipeline._state
-            )
+            self._free = list(range(cap - 1, 0, -1))
+            if cap == 1:
+                self.stacked = _tree_map(
+                    lambda l: jnp.asarray(l)[None], pipeline._state
+                )
+            else:
+                self.stacked = self._pin(_tree_map(
+                    lambda l: jnp.broadcast_to(
+                        jnp.asarray(l)[None],
+                        (cap,) + jnp.asarray(l).shape,
+                    ),
+                    pipeline._state,
+                ))
             pipeline._cohort = self
             pipeline._slot = 0
             pipeline._state = None
@@ -301,12 +509,14 @@ class Cohort:
             return 0
         if not self._free:
             self._grow()
-        slot = self._free.pop()
-        state = pipeline._state
-        self.stacked = _tree_map(
-            lambda leaf, v: leaf.at[slot].set(jnp.asarray(v)),
+        slot = self._pick_slot()
+        state = self._host_state_leaves(pipeline._state)
+        self.stacked = self._pin(_tree_map(
+            lambda leaf, v: leaf.at[slot].set(
+                v if isinstance(v, np.ndarray) else jnp.asarray(v)
+            ),
             self.stacked, state,
-        )
+        ))
         self.members[slot] = pipeline
         self.n_active += 1
         pipeline._cohort = self
@@ -320,7 +530,7 @@ class Cohort:
         pipeline and the slot returns to the free list for churn reuse."""
         self.launch()
         slot = pipeline._slot
-        pipeline._state = _tree_map(lambda l: l[slot], self.stacked)
+        pipeline._state = self._member_pull(slot)
         pipeline._cohort = None
         pipeline._slot = -1
         self.members[slot] = None
@@ -332,15 +542,54 @@ class Cohort:
 
     def _grow(self) -> None:
         """Double capacity (power-of-two buckets): the new region is filled
-        with duplicated rows — inert until a slot is seeded by attach."""
+        with duplicated rows — inert until a slot is seeded by attach.
+
+        Sharded cohorts double EACH SHARD'S contiguous block in place
+        (slot ``i*per + j`` remaps to ``i*2*per + j``): every member stays
+        on its shard across growth, so placement balance survives and the
+        one-time data movement is shard-local. Growth only happens from
+        :meth:`attach`, right after a launch barrier — staging counts,
+        launch groups and deferred actions are all empty, so only the
+        membership maps and pending host writes carry slot keys."""
         old = self.capacity
-        self.stacked = _tree_map(
-            lambda l: jnp.concatenate([l, l], axis=0), self.stacked
-        )
-        self.members.extend([None] * old)
-        self._free.extend(range(old * 2 - 1, old - 1, -1))
-        self._free.sort(reverse=True)
+        if self.n_shards == 1:
+            self.stacked = _tree_map(
+                lambda l: jnp.concatenate([l, l], axis=0), self.stacked
+            )
+            self.members.extend([None] * old)
+            self._free.extend(range(old * 2 - 1, old - 1, -1))
+            self._free.sort(reverse=True)
+            self.capacity = old * 2
+            return
+        per = old // self.n_shards
+
+        def dbl(l):
+            blocks = l.reshape((self.n_shards, per) + l.shape[1:])
+            blocks = jnp.concatenate([blocks, blocks], axis=1)
+            return blocks.reshape((old * 2,) + l.shape[1:])
+
+        self.stacked = self._pin(_tree_map(dbl, self.stacked))
+        remap = {
+            s: (s // per) * 2 * per + (s % per) for s in range(old)
+        }
+        new_members: List[Optional[Any]] = [None] * (old * 2)
+        for s, member in enumerate(self.members):
+            if member is not None:
+                new_members[remap[s]] = member
+                member._slot = remap[s]
+        self.members = new_members
+        self._host_state = {
+            remap[s]: v for s, v in self._host_state.items()
+        }
+        self._pending_flat = {
+            remap[s]: v for s, v in self._pending_flat.items()
+        }
         self.capacity = old * 2
+        self._free = sorted(
+            (s for s in range(old * 2) if new_members[s] is None),
+            reverse=True,
+        )
+        self._flat_cache = None
 
     # --- staging ----------------------------------------------------------
 
@@ -493,6 +742,14 @@ class Cohort:
     def _timed(self):
         return self.timer if self.timer is not None else contextlib.nullcontext()
 
+    def _timed_serve(self):
+        """Gang predict launches (forecast serving flushes) time into the
+        serve timer, not the fit flush timer, so launch_timing() reports
+        the serving plane's launch percentiles separately."""
+        if self.serve_timer is not None:
+            return self.serve_timer
+        return self._timed()
+
     def _run_staged(self) -> None:
         self._apply_host_writes()
         if not self._counts:
@@ -528,12 +785,15 @@ class Cohort:
             if self.guarded:
                 losses = self._note_health(losses, counts)
         else:
-            xs = self._buf_x[:, :t_pad]
-            ys = self._buf_y[:, :t_pad]
-            ms = self._buf_m[:, :t_pad]
+            # sharded cohorts ship each device its own contiguous block of
+            # the slot-major staging buffers (_stage_dev); unsharded, the
+            # numpy views go straight to the dispatch. Either way the
+            # transfer copies before the call returns, so reusing the
+            # staging buffers after is safe
+            xs = self._stage_dev(self._buf_x[:, :t_pad])
+            ys = self._stage_dev(self._buf_y[:, :t_pad])
+            ms = self._stage_dev(self._buf_m[:, :t_pad])
             with self._timed():
-                # the dispatch copies host buffers to device arrays before
-                # it returns, so reusing the staging buffers after is safe
                 self.stacked, losses = self._gfit(self.stacked, xs, ys, ms)
             # re-zero ONLY the staged mask region: everything else is
             # already zero, and stale x/y rows under a zero mask are inert
@@ -552,9 +812,11 @@ class Cohort:
         materialized ONCE here (the launch just ran, so this is one small
         transfer) — per-slot lazy device slices would cost every member
         its own blocking transfer at the next guard tick, C tiny syncs in
-        exactly the dispatch-overhead regime cohorts exist to collapse."""
+        exactly the dispatch-overhead regime cohorts exist to collapse.
+        Sharded cohorts gather the vector per shard in one parallel
+        device_get (guard.gang_health_values)."""
         losses, sq_norm = gang_out
-        vals = np.asarray(sq_norm)
+        vals = gang_health_values(sq_norm)
         for slot, n in counts.items():
             member = self.members[slot]
             if member is not None and member.guard is not None:
@@ -566,10 +828,14 @@ class Cohort:
         rows) back into the stacked tree before the next program runs."""
         if self._host_state:
             for slot, st in self._host_state.items():
+                st = self._host_state_leaves(st)
                 self.stacked = _tree_map(
-                    lambda leaf, v: leaf.at[slot].set(jnp.asarray(v)),
+                    lambda leaf, v: leaf.at[slot].set(
+                        v if isinstance(v, np.ndarray) else jnp.asarray(v)
+                    ),
                     self.stacked, st,
                 )
+            self.stacked = self._pin(self.stacked)
             self._host_state.clear()
             self._flat_cache = None
         if self._pending_flat:
@@ -586,11 +852,21 @@ class Cohort:
                 slots + [slots[0]] * (k - len(slots)), np.int32
             )
             new_params = self._junflat(jnp.asarray(mat))
-            jidx = jnp.asarray(idx)
-            self.stacked["params"] = _tree_map(
-                lambda leaf, u: leaf.at[jidx].set(u),
-                self.stacked["params"], new_params,
-            )
+            if self._sharding is not None:
+                # host-leaf updates + numpy indices: the scatter operands
+                # must not be committed to one device while the target is
+                # mesh-sharded
+                new_params = _tree_map(lambda l: np.asarray(l), new_params)
+                self.stacked["params"] = self._pin(_tree_map(
+                    lambda leaf, u: leaf.at[idx].set(u),
+                    self.stacked["params"], new_params,
+                ))
+            else:
+                jidx = jnp.asarray(idx)
+                self.stacked["params"] = _tree_map(
+                    lambda leaf, u: leaf.at[jidx].set(u),
+                    self.stacked["params"], new_params,
+                )
             self._pending_flat.clear()
 
     # --- member state access ---------------------------------------------
@@ -603,7 +879,7 @@ class Cohort:
         st = self._host_state.get(slot)
         if st is None:
             self.launch()
-            st = _tree_map(lambda l: l[slot], self.stacked)
+            st = self._member_pull(slot)
             pend = self._pending_flat.pop(slot, None)
             if pend is not None:
                 st["params"] = self._unravel(jnp.asarray(pend))
@@ -623,7 +899,7 @@ class Cohort:
         if st is not None:
             return st
         self.launch()
-        return _tree_map(lambda l: l[slot], self.stacked)
+        return self._member_pull(slot)
 
     def member_flat(self, slot: int):
         """(flat params row copy, unravel) — the gang get_flat: the [C, P]
@@ -688,8 +964,8 @@ class Cohort:
             xs[slot] = xb
         self._pred_dirty[shape[1:]] = [slot for slot, _ in entries]
         self._note_launch(entries[0][0])
-        with self._timed():
-            out = self._gpred(self.stacked, xs)
+        with self._timed_serve():
+            out = self._gpred(self.stacked, self._stage_dev(xs))
         return np.asarray(out)
 
 
@@ -697,7 +973,7 @@ class CohortEngine:
     """Per-spoke cohort manager: groups eligible pipelines by jit-cache key
     and forms cohorts per the configured mode/threshold."""
 
-    def __init__(self, config, timer=None):
+    def __init__(self, config, timer=None, serve_timer=None):
         mode = str(getattr(config, "cohort", "off")).lower()
         self.mode = mode if mode in ("auto", "on") else "off"
         self.min_members = (
@@ -709,7 +985,11 @@ class CohortEngine:
             self.use_vmap = jax.default_backend() != "cpu"
         else:
             self.use_vmap = impl == "vmap"
+        # tenant-axis device sharding (JobConfig.cohort_shards): resolved
+        # once per engine; every cohort this engine forms shares the width
+        self.n_shards = resolve_cohort_shards(config)
         self.timer = timer
+        self.serve_timer = serve_timer
         self.cohorts: Dict[Any, Cohort] = {}
         self._pool: Dict[Any, List[Any]] = {}
 
@@ -749,7 +1029,10 @@ class CohortEngine:
         pool = self._pool.setdefault(key, [])
         pool.append(pipeline)
         if len(pool) >= self.min_members:
-            cohort = Cohort(pool[0], self.use_vmap, timer=self.timer)
+            cohort = Cohort(
+                pool[0], self.use_vmap, timer=self.timer,
+                n_shards=self.n_shards, serve_timer=self.serve_timer,
+            )
             for p in pool[1:]:
                 cohort.attach(p)
             self.cohorts[key] = cohort

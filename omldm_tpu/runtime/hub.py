@@ -207,7 +207,11 @@ class HubManager:
         self._liveness_deadline = 0.0
         self._liveness_period = 0.0
         # cohort gang averaging: same-cohort PS shards stage completed
-        # rounds inside a job event window and average in one stacked op
+        # rounds inside a job event window and average in one stacked
+        # [M, W, P] numpy reduction (bit-identical mean). With the tenant
+        # axis device-sharded, the member flat slices the hubs stage come
+        # out of the cohort's ONE-launch sharded [C, P] flat matrix — the
+        # reduction itself stays host-side and exact either way
         self.gang = None
         if str(getattr(config, "cohort", "off")).lower() in ("auto", "on"):
             from omldm_tpu.runtime.cohort import GangAverager
@@ -242,6 +246,11 @@ class HubManager:
 
         hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
         hub.node.gang = self.gang
+        # the tenant-mesh width gauge (Statistics.cohort_shards) is NOT
+        # stamped here from config: a pipeline that never cohorts (sparse,
+        # host-side, pooled below cohort_min) must report 0, so only the
+        # spoke-side fold of the ACTUALLY-engaged shard count
+        # (Spoke.emit_query_response) feeds it
         self.hubs[key] = hub
         self._any_liveness = self._any_liveness or hub.node.liveness_armed
         self._refresh_liveness_period()

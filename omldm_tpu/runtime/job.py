@@ -605,16 +605,55 @@ class StreamJob:
             bridge.handle_batch(x, y, op)
 
     def launch_timing(self) -> dict:
-        """Pooled spoke flush-path StepTimer summary: per-launch ms
-        percentiles (p50/p99) + launches/sec across every spoke — the
-        dispatch-cost observability twin of the bytesShipped counters."""
+        """Pooled spoke StepTimer summary — the dispatch-cost
+        observability twin of the bytesShipped counters. Top-level keys
+        are the FIT flush path's per-launch ms percentiles (p50/p99) +
+        launches/sec across every spoke; the ``serve_*`` keys carry the
+        SERVING-launch percentiles (immediate per-record predicts,
+        serving-plane flush launches, and cohort gang predicts — the
+        paths Spoke.serve_timer wraps)."""
         from omldm_tpu.utils.tracing import StepTimer
 
         pooled = StepTimer("spoke_flush")
+        serve = StepTimer("serve_flush")
         for spoke in self.spokes:
             for d in spoke.step_timer._durations_ms:
                 pooled.record(d)
-        return pooled.summary()
+            for d in spoke.serve_timer._durations_ms:
+                serve.record(d)
+        out = pooled.summary()
+        ssum = serve.summary()
+        # counts report the TRUE totals (StepTimer.cap contract): the
+        # spokes' bounded rings only carry the percentile windows
+        out["count"] = sum(s.step_timer.count for s in self.spokes)
+        out["serve_count"] = sum(s.serve_timer.count for s in self.spokes)
+        out["serve_p50_ms"] = ssum["p50_ms"]
+        out["serve_p99_ms"] = ssum["p99_ms"]
+        return out
+
+    def tenant_topology(self) -> dict:
+        """Where the co-hosted tenants actually run: the local device
+        count, the widest engaged tenant-mesh shard count, and each live
+        cohort's per-shard active-member placement — recorded by the
+        multi-tenant benchmark sweep so BENCH rounds can attribute
+        throughput to mesh width."""
+        import jax
+
+        topo = {
+            "devices": jax.local_device_count(),
+            "cohort_shards": 1,
+            "placement": [],
+        }
+        for spoke in self.spokes:
+            engine = spoke.cohorts
+            if engine is None:
+                continue
+            for cohort in engine.cohorts.values():
+                topo["cohort_shards"] = max(
+                    topo["cohort_shards"], cohort.n_shards
+                )
+                topo["placement"].append(cohort.shard_placement())
+        return topo
 
     def ensure_deployed(self, dim: int) -> None:
         """Deploy any Create requests still waiting on a feature width —

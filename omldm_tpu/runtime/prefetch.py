@@ -28,23 +28,29 @@ def prefetch(source: Iterable[T], depth: int = 2) -> Iterator[T]:
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
     stop = threading.Event()
 
+    def put_until_stopped(item) -> bool:
+        """Stop-aware bounded put: retry until the consumer drains a slot
+        or abandons the iterator (stop set). True when delivered."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def run() -> None:
         try:
             for item in source:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
+                if not put_until_stopped(item):
                     return
-            q.put(_SENTINEL)
+            put_until_stopped(_SENTINEL)
         except BaseException as e:  # propagate to the consumer
-            try:
-                q.put(e)
-            except Exception:
-                pass
+            # NEVER dropped: with the bounded queue full at raise time, a
+            # fire-and-forget put would either block this thread forever
+            # or (swallowed) starve the consumer of both the error and
+            # the sentinel
+            put_until_stopped(e)
 
     t = threading.Thread(target=run, daemon=True)
     t.start()

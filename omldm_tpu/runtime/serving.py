@@ -246,12 +246,17 @@ class ServingPlane:
         emit_prediction: Callable[[Prediction], None],
         clock: Callable[[], float] = time.perf_counter,
         emit_predictions: Optional[Callable[[List[Prediction]], None]] = None,
+        timer=None,
     ):
         self._emit = emit_prediction
         # bulk sink hand-off (one call per flush instead of one per
         # prediction) when the hosting runtime provides it
         self._emit_many = emit_predictions
         self._clock = clock
+        # serving-launch StepTimer (Spoke.serve_timer): solo flush predict
+        # dispatches time here; gang flushes time inside
+        # Cohort.predict_rows against the same timer
+        self._timer = timer
         # nets with a non-empty queue, keyed by network id (insertion
         # order = first-enqueue order, the cross-net emission order)
         self._pending: Dict[int, Any] = {}
@@ -434,7 +439,17 @@ class ServingPlane:
         else:
             xb = net.predict_pad(n_rows)
             self._fill_pad(xb, entries)
-        preds = net.node.on_forecast_batch(xb)
+        cohort = getattr(net.pipeline, "_cohort", None)
+        if cohort is not None:
+            # drain staged gang fits OUTSIDE the serve timer: the
+            # predict's peek_state would otherwise launch them inside it,
+            # double-attributing fit time to serving percentiles
+            cohort.launch()
+        if self._timer is not None:
+            with self._timer:
+                preds = net.node.on_forecast_batch(xb)
+        else:
+            preds = net.node.on_forecast_batch(xb)
         self._emit_entries(net, entries, n_rows, preds)
 
     def _emit_entries(

@@ -366,12 +366,22 @@ class Spoke:
         self.nets: Dict[int, SpokeNet] = {}
         # flush-path step timing: per-launch ms percentiles (StepTimer
         # summary) emittable alongside bytesShipped — covers per-pipeline
-        # flush dispatch AND cohort gang launches
-        self.step_timer = StepTimer("spoke_flush")
+        # flush dispatch AND cohort gang launches. Both timers sit on
+        # long-lived streaming hot paths, so their sample windows are
+        # BOUNDED rings (count stays total; percentiles summarize the
+        # most recent window, same policy as ServeStats' latency ring)
+        self.step_timer = StepTimer("spoke_flush", cap=65536)
+        # serving-launch timing: per-launch ms percentiles for forecast
+        # predict dispatches — the immediate per-record path, batched
+        # serving-plane flushes, AND cohort gang predicts — reported
+        # separately from the fit flush path by StreamJob.launch_timing()
+        self.serve_timer = StepTimer("serve_flush", cap=65536)
         # cohort execution engine (JobConfig.cohort): groups same-spec
         # pipelines for gang-scheduled dispatch; None when off — every
         # route below then takes the exact per-pipeline code path
-        engine = CohortEngine(config, timer=self.step_timer)
+        engine = CohortEngine(
+            config, timer=self.step_timer, serve_timer=self.serve_timer
+        )
         self.cohorts: Optional[CohortEngine] = (
             engine if engine.enabled else None
         )
@@ -460,6 +470,7 @@ class Spoke:
             self.serving_plane = ServingPlane(
                 self._emit_prediction,
                 emit_predictions=self._emit_predictions,
+                timer=self.serve_timer,
             )
         self._any_serving = True
         return self.serving_plane
@@ -706,12 +717,14 @@ class Spoke:
                 self._serve(net, inst, (sidx[j], sval[j]))
             return
         rows = self._adapt_width(x[f_idx], net.dim)
+        self._drain_staged_fits(net)
         for s in range(0, f_idx.size, PREDICT_BATCH):
             chunk = rows[s : s + PREDICT_BATCH]
             t0 = time.perf_counter()
             xb = net.predict_pad(chunk.shape[0])
             xb[: chunk.shape[0]] = chunk
-            preds = net.node.on_forecast_batch(xb)
+            with self.serve_timer:
+                preds = net.node.on_forecast_batch(xb)
             for j in range(chunk.shape[0]):
                 inst = DataInstance(
                     numerical_features=chunk[j].tolist(),
@@ -775,11 +788,23 @@ class Spoke:
         else:
             xb = net.predict_pad(1)
             xb[0] = x
-        preds = net.node.on_forecast_batch(xb)
+        self._drain_staged_fits(net)
+        with self.serve_timer:
+            preds = net.node.on_forecast_batch(xb)
         self._emit_prediction(
             Prediction(net.request.id, inst, float(preds[0]))
         )
         net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
+
+    @staticmethod
+    def _drain_staged_fits(net: SpokeNet) -> None:
+        """Launch a cohort member's staged gang fits BEFORE a serve-timed
+        predict: the predict's peek_state would otherwise drain them
+        inside the serving timer, double-attributing the fit launch (it
+        times itself into the flush timer) to serve_launch percentiles."""
+        cohort = net.pipeline._cohort
+        if cohort is not None:
+            cohort.launch()
 
     # --- query / termination (FlinkSpoke.scala:136-171) ---
 
@@ -819,6 +844,17 @@ class Spoke:
                 net.request.id, 0, "program_launches", net.program_launches
             )
             net.program_launches = 0
+        # tenant-mesh width gauge: record the shard count the pipeline's
+        # cohort launches actually ran across (max-combined hub-side)
+        cohort = net.pipeline._cohort
+        if (
+            self._note_wire is not None
+            and cohort is not None
+            and cohort.n_shards > 1
+        ):
+            self._note_wire(
+                net.request.id, 0, "cohort_shards", cohort.n_shards
+            )
         # serving telemetry rides the same fold: the served count is a
         # plain counter, the latency percentiles a (p50, p99, p999) triple
         # the job routes to Statistics.note_serve_latency

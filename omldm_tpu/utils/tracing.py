@@ -33,11 +33,21 @@ def trace(log_dir: Optional[str]):
 
 
 class StepTimer:
-    """Record per-step wall-clock durations and summarize percentiles."""
+    """Record per-step wall-clock durations and summarize percentiles.
 
-    def __init__(self, name: str = "step"):
+    ``cap`` bounds the retained sample window (a ring of the most recent
+    ``cap`` durations, like ServeStats' latency ring): a timer on a
+    per-record hot path of a long-lived streaming job must not grow host
+    memory with the stream. ``count`` stays the TOTAL recorded;
+    percentiles summarize the retained window. ``cap=None`` (default)
+    keeps every sample — the pre-existing behavior for short-lived
+    profiling timers."""
+
+    def __init__(self, name: str = "step", cap: Optional[int] = None):
         self.name = name
+        self.cap = cap
         self._durations_ms: List[float] = []
+        self._total = 0
         # a stack: one shared timer may wrap NESTED steps (a flush whose
         # protocol reply synchronously drains another pipeline's flush)
         self._starts: List[float] = []
@@ -47,17 +57,19 @@ class StepTimer:
         return self
 
     def __exit__(self, *exc):
-        self._durations_ms.append(
-            (time.perf_counter() - self._starts.pop()) * 1000.0
-        )
+        self.record((time.perf_counter() - self._starts.pop()) * 1000.0)
         return False
 
     def record(self, duration_ms: float) -> None:
-        self._durations_ms.append(float(duration_ms))
+        if self.cap is not None and len(self._durations_ms) >= self.cap:
+            self._durations_ms[self._total % self.cap] = float(duration_ms)
+        else:
+            self._durations_ms.append(float(duration_ms))
+        self._total += 1
 
     @property
     def count(self) -> int:
-        return len(self._durations_ms)
+        return self._total
 
     def summary(self) -> Dict[str, float]:
         """{count, mean_ms, p50_ms, p99_ms, steps_per_sec}; zeros if empty."""
@@ -69,7 +81,7 @@ class StepTimer:
         d = np.asarray(self._durations_ms)
         mean = float(d.mean())
         return {
-            "count": int(d.size),
+            "count": self._total,
             "mean_ms": mean,
             "p50_ms": float(np.percentile(d, 50)),
             "p99_ms": float(np.percentile(d, 99)),
@@ -78,3 +90,4 @@ class StepTimer:
 
     def reset(self) -> None:
         self._durations_ms = []
+        self._total = 0

@@ -1,0 +1,89 @@
+"""Background prefetch (runtime/prefetch.py): error propagation.
+
+Regression pins for the producer's exception path: a source that raises
+while the bounded queue is FULL must still deliver the exception to the
+consumer (the old fire-and-forget error put could be dropped/stuck, so the
+consumer hung until sentinel starvation), and an abandoned iterator must
+release the producer thread.
+"""
+
+import threading
+import time
+
+import pytest
+
+from omldm_tpu.runtime.prefetch import prefetch
+
+
+def _drain_with_watchdog(it, consume_delay=0.0, timeout=10.0):
+    """Consume ``it`` on a worker thread so a hung iterator fails the test
+    instead of hanging the suite; returns (items, exception)."""
+    out = {"items": [], "exc": None}
+
+    def run():
+        try:
+            for item in it:
+                out["items"].append(item)
+                if consume_delay:
+                    time.sleep(consume_delay)
+        except BaseException as e:  # noqa: BLE001 - the assertion target
+            out["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "consumer hung: the source's error was lost"
+    return out["items"], out["exc"]
+
+
+class TestPrefetchErrors:
+    def test_error_propagates_when_queue_full(self):
+        """The regression: depth-1 queue, a slow consumer keeps it full at
+        the moment the source raises — the stop-aware error put must wait
+        for a slot and deliver, after every buffered item."""
+
+        def source():
+            yield 1
+            yield 2
+            raise RuntimeError("boom")
+
+        items, exc = _drain_with_watchdog(
+            prefetch(source(), depth=1), consume_delay=0.3
+        )
+        assert items == [1, 2]
+        assert isinstance(exc, RuntimeError) and "boom" in str(exc)
+
+    def test_error_before_first_item(self):
+        def source():
+            raise ValueError("early")
+            yield  # pragma: no cover
+
+        items, exc = _drain_with_watchdog(prefetch(source(), depth=2))
+        assert items == []
+        assert isinstance(exc, ValueError)
+
+    def test_clean_stream_unchanged(self):
+        items, exc = _drain_with_watchdog(prefetch(iter(range(64)), depth=2))
+        assert items == list(range(64))
+        assert exc is None
+
+    def test_abandoned_consumer_releases_producer(self):
+        """Breaking out of the iterator (stop set in the finally) must let
+        the producer exit even when it is mid-retry on a full queue —
+        including the raising producer's error put."""
+
+        def source():
+            for i in range(100):
+                yield i
+            raise RuntimeError("never consumed")
+
+        before = threading.active_count()
+        it = prefetch(source(), depth=1)
+        assert next(it) == 0
+        it.close()  # generator finally -> stop.set()
+        deadline = time.time() + 10.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, (
+            "producer thread still alive after the consumer abandoned"
+        )

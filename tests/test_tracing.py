@@ -21,6 +21,23 @@ def test_step_timer_percentiles():
     assert t.summary()["count"] == 0
 
 
+def test_step_timer_bounded_ring():
+    """A capped timer retains at most `cap` samples (most recent window)
+    while `count` stays the total — a hot-path timer on a long-lived
+    streaming job must not grow host memory with the stream."""
+    t = StepTimer("serve", cap=4)
+    for ms in range(10):
+        t.record(float(ms))
+    assert t.count == 10
+    assert len(t._durations_ms) == 4
+    assert sorted(t._durations_ms) == [6.0, 7.0, 8.0, 9.0]
+    s = t.summary()
+    assert s["count"] == 10
+    assert 6.0 <= s["p50_ms"] <= 9.0
+    t.reset()
+    assert t.count == 0 and t.summary()["count"] == 0
+
+
 def test_step_timer_context_manager():
     t = StepTimer()
     with t:
