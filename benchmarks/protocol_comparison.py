@@ -158,6 +158,17 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "serve_latency_p50_ms": round(stats.serve_latency_p50_ms, 3),
         "serve_latency_p99_ms": round(stats.serve_latency_p99_ms, 3),
         "serve_latency_p999_ms": round(stats.serve_latency_p999_ms, 3),
+        # overload-control counters (runtime/overload.py): zero with the
+        # plane unarmed; under pressure the shed/throttle/pressure gauges
+        # engage (--overload-smoke gates them)
+        "forecasts_shed": stats.forecasts_shed,
+        "records_throttled": stats.records_throttled,
+        "pressure_level": stats.pressure_level,
+        "shed_latency_ms": round(stats.shed_latency_ms, 3),
+        # end-of-run queue-depth snapshot (uniform accessors: serving
+        # rows, batcher backlog, throttled rows, paused rows) — nonzero
+        # values at terminate mean stranded work
+        "queue_depths": job.queue_depths(),
         # serving-LAUNCH percentiles (Spoke.serve_timer): per predict
         # dispatch ms on the immediate, batched-plane and gang serve
         # paths — the launch-cost twin of the enqueue->emit latencies
@@ -237,6 +248,7 @@ def run_multi_tenant_one(n_pipe, x, y, batch, cohort, test=False,
         "devices": topo["devices"],
         "cohort_shards": topo["cohort_shards"],
         "tenant_placement": topo["placement"],
+        "queue_depths": topo["queues"],
     }
 
 
@@ -421,6 +433,7 @@ def run_serving_one(n_pipe, x, y, op, batch, serving, cohort="off",
             s.program_launches for s in report.statistics
         ),
         "score": round(stats.score, 4),
+        "queue_depths": job.queue_depths(),
     }
     if collect_preds:
         preds = {}
@@ -478,6 +491,117 @@ def run_serving_comparison(mix, records, batch, pipeline_counts=(64,)):
             }
         out[str(n)] = rows
     return out
+
+
+# the overload-smoke operating point (ISSUE 10): 64 co-hosted tenants on
+# a 50/50 train/forecast per-record stream, a 10x forecast burst flooding
+# tenant 0 through the middle half of the stream, serving armed with a
+# 500 ms delay budget (a fan-out forecast fills all 64 solo queues, so a
+# fill cycle dispatches 64 predict launches back to back — a single-core
+# CI box needs the headroom; tight enough that stranded queues or a
+# burst-induced latency collapse still fails), and the controller tuned
+# so the burst traverses the WHOLE ladder (ELEVATED throttling ->
+# CRITICAL shedding) and decays back to OK inside the post-burst tail
+OVERLOAD_SPEC = "window=32,share=2,hotHigh=24,hotCritical=48,cool=24"
+OVERLOAD_SERVING = {"maxBatch": 64, "maxDelayMs": 500.0}
+OVERLOAD_BURST = 10
+
+
+def _overload_chaos(records: int) -> str:
+    # burst window in FORECAST records (mix 0.5 => records/2 forecasts):
+    # the middle half floods, leaving a clean ramp and a decay tail
+    n_fore = records // 2
+    return (
+        f"seed=7,burst={OVERLOAD_BURST},burstFrom={n_fore // 4},"
+        f"burstLen={n_fore // 2},hotTenant=0"
+    )
+
+
+def run_overload_one(n_pipe, x, y, burst, records=None, batch=256,
+                     overload=OVERLOAD_SPEC, serving=OVERLOAD_SERVING):
+    """One overload job: N same-spec pipelines fed the PER-RECORD route
+    (tenant-addressed burst clones need record-level routing) with a
+    50/50 train/forecast mix; ``burst`` arms the seeded hot-tenant
+    injector. Reports hot/healthy split of the serving + shed counters."""
+    import numpy as np
+
+    from omldm_tpu.api.data import DataInstance, FORECASTING
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import (
+        FORECASTING_STREAM,
+        REQUEST_STREAM,
+        TRAINING_STREAM,
+    )
+
+    records = records or x.shape[0]
+    # cohort off: the smoke measures the overload plane on SOLO per-tenant
+    # dispatch (the reference's serving semantics; the cohort axis has its
+    # own gates), and the per-event gang bookkeeping would otherwise tax
+    # every injected burst clone
+    job = StreamJob(JobConfig(
+        parallelism=1, batch_size=batch, test_set_size=64, test=False,
+        cohort="off", overload=overload, serving="",
+        chaos=_overload_chaos(records) if burst else "",
+    ))
+    for pid in range(n_pipe):
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": pid, "request": "Create",
+            "learner": {
+                "name": "PA", "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": int(x.shape[1])},
+            },
+            "trainingConfiguration": {
+                "protocol": "Asynchronous", "syncEvery": 4,
+                "serving": serving,
+            },
+        }))
+    # untimed warmup (compiles fit + padded predict programs)
+    warm = min(512, records // 4)
+    for i in range(warm):
+        if i % 2 == 0:
+            job.process_event(FORECASTING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), operation=FORECASTING))
+        else:
+            job.process_event(TRAINING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), target=float(y[i])))
+    t0 = time.perf_counter()
+    for i in range(warm, records):
+        if i % 2 == 0:
+            job.process_event(FORECASTING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), operation=FORECASTING))
+        else:
+            job.process_event(TRAINING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), target=float(y[i])))
+    elapsed = time.perf_counter() - t0
+    level_after_feed = job.overload_level()
+    report = job.terminate()
+    by_pipe = {s.pipeline: s for s in report.statistics}
+    hot = by_pipe[0]
+    healthy = [s for p, s in by_pipe.items() if p != 0]
+    healthy_served = sum(s.forecasts_served for s in healthy)
+    return {
+        "pipelines": n_pipe,
+        "records": records,
+        "burst": bool(burst),
+        "elapsed_s": round(elapsed, 3),
+        "healthy_forecasts_served": healthy_served,
+        "healthy_forecasts_per_sec": round(healthy_served / elapsed, 1),
+        "healthy_serve_p99_ms": round(
+            max((s.serve_latency_p99_ms for s in healthy), default=0.0), 3
+        ),
+        "healthy_shed": sum(s.forecasts_shed for s in healthy),
+        "hot_served": hot.forecasts_served,
+        "hot_shed": hot.forecasts_shed,
+        "hot_throttled": hot.records_throttled,
+        "pressure_peak": max(s.pressure_level for s in by_pipe.values()),
+        "level_after_feed": level_after_feed,
+        "shed_latency_ms": round(
+            max(s.shed_latency_ms for s in by_pipe.values()), 3
+        ),
+        "dead_letter_reasons": dict(job.dead_letter.by_reason),
+        "queue_depths": job.queue_depths(),
+    }
 
 
 # codecs swept by --codec sweep, and the host protocols the codec section
@@ -680,6 +804,17 @@ def main() -> None:
              "< 5x the per-record forecast throughput, exact-mode "
              "predictions/scores diverge from per-record serving, or the "
              "serving p99 latency exceeds the maxDelayMs budget",
+    )
+    ap.add_argument(
+        "--overload-smoke", action="store_true",
+        help="CI gate: 64 co-hosted tenants, 50/50 train/forecast "
+             "per-record stream, a seeded 10x forecast burst flooding one "
+             "hot tenant through the middle of the stream; NONZERO EXIT "
+             "if the shed/throttle counters never engage, a healthy "
+             "tenant gets shed, healthy tenants' serving p99 leaves the "
+             "maxDelayMs budget, healthy forecast throughput drops more "
+             "than 10%% vs the no-burst baseline, or the controller "
+             "fails to return to OK after the burst",
     )
     ap.add_argument(
         "--chaos-smoke", action="store_true",
@@ -1095,6 +1230,110 @@ def main() -> None:
             "records": records,
             "poison_spec": poison_spec,
             **out,
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
+
+    if args.overload_smoke:
+        # CI gate (ISSUE 10 acceptance): at 64 co-hosted tenants on a
+        # 50/50 per-record stream with a seeded 10x forecast burst
+        # flooding tenant 0:
+        #   (a) the overload counters must ENGAGE — the hot tenant sheds
+        #       forecasts (reason-coded dead letters) and has training
+        #       rows deprioritized, and the pressure gauge records
+        #       CRITICAL;
+        #   (b) fairness must hold — NO healthy tenant sheds, and every
+        #       healthy tenant serves EXACTLY the forecasts it serves in
+        #       the no-burst leg (count equality: the schedule is
+        #       deterministic);
+        #   (c) healthy tenants' serving p99 stays inside the maxDelayMs
+        #       budget and their aggregate forecast throughput within 10%
+        #       of the no-burst baseline (best of 3 paired trials — the
+        #       per-record baseline is dispatch-bound and noisy on shared
+        #       CI boxes);
+        #   (d) the controller must RECOVER: pressure back to OK by the
+        #       end of the post-burst tail, with no stranded queue rows.
+        records = min(args.records, 4_096)
+        x, y = _mt_stream(records)
+        # warmup job compiles the fit + padded-predict program families
+        # into the shared jit cache (same-spec jobs reuse them)
+        run_overload_one(64, x[:1024], y[:1024], burst=False)
+        best = None
+        for _trial in range(3):
+            base = run_overload_one(64, x, y, burst=False)
+            burst = run_overload_one(64, x, y, burst=True)
+            ratio = (
+                burst["healthy_forecasts_per_sec"]
+                / max(base["healthy_forecasts_per_sec"], 1e-9)
+            )
+            if best is None or ratio > best[0]:
+                best = (ratio, base, burst)
+        ratio, base, burst = best
+        failures = []
+        if burst["hot_shed"] == 0:
+            failures.append(
+                "the burst never engaged shedding (hot_shed == 0) — the "
+                "fairness checks are vacuous"
+            )
+        if burst["hot_throttled"] == 0:
+            failures.append(
+                "the burst never engaged training deprioritization "
+                "(hot_throttled == 0)"
+            )
+        if burst["pressure_peak"] < 2:
+            failures.append(
+                f"pressure never reached CRITICAL (peak "
+                f"{burst['pressure_peak']})"
+            )
+        if burst["healthy_shed"] != 0:
+            failures.append(
+                f"{burst['healthy_shed']} healthy-tenant forecasts were "
+                "shed — fairness violated"
+            )
+        if burst["healthy_forecasts_served"] != base["healthy_forecasts_served"]:
+            failures.append(
+                "healthy tenants' served-forecast count diverged under "
+                f"the burst ({burst['healthy_forecasts_served']} vs "
+                f"{base['healthy_forecasts_served']})"
+            )
+        budget = OVERLOAD_SERVING["maxDelayMs"]
+        if burst["healthy_serve_p99_ms"] > budget:
+            failures.append(
+                f"healthy serving p99 {burst['healthy_serve_p99_ms']}ms "
+                f"over the {budget}ms maxDelayMs budget under the burst"
+            )
+        if burst["healthy_serve_p99_ms"] > base["healthy_serve_p99_ms"] * 1.5:
+            failures.append(
+                "the burst degraded healthy serving p99 "
+                f"({burst['healthy_serve_p99_ms']}ms vs "
+                f"{base['healthy_serve_p99_ms']}ms no-burst — > 1.5x)"
+            )
+        if ratio < 0.9:
+            failures.append(
+                f"healthy forecast throughput {ratio:.2f}x of the "
+                "no-burst baseline (< 0.9x bar)"
+            )
+        if burst["level_after_feed"] != 0:
+            failures.append(
+                "controller did not return to OK after the burst "
+                f"(level {burst['level_after_feed']})"
+            )
+        stranded = {
+            k: v for k, v in burst["queue_depths"].items()
+            if k != "pressure_level" and v
+        }
+        if stranded:
+            failures.append(f"stranded queue rows at terminate: {stranded}")
+        print(json.dumps({
+            "config": "protocol_comparison_overload_smoke",
+            "records": records,
+            "overload_spec": OVERLOAD_SPEC,
+            "chaos_spec": _overload_chaos(records),
+            "healthy_throughput_ratio": round(ratio, 3),
+            "no_burst": base,
+            "burst": burst,
             "failures": failures,
         }))
         if failures:

@@ -255,6 +255,11 @@ def _kafka_loop(job: StreamJob, events, flags: Dict[str, str], profile: Dict) ->
 
                 jax.profiler.stop_trace()
                 profile["tracing"] = False
+        else:
+            # idle / backpressure-paused poll window: idle capacity decays
+            # the overload counters so a CRITICAL pause can clear (no-op
+            # when the plane is unarmed)
+            job.overload_idle_tick()
         job.check_silence()
         if job.stats.terminated:
             break
@@ -309,9 +314,17 @@ def _run_kafka(job: StreamJob, flags: Dict[str, str]) -> int:
     manager = job.checkpoint_manager
     ckpt_floor = manager.latest_path() if manager is not None else None
     tracker: Dict = {}
+    # upstream backpressure (runtime/overload.py): while any spoke's
+    # overload controller reports CRITICAL, the polling loop stops
+    # consuming — offsets stay uncommitted, so paused traffic replays
+    # instead of buffering. The indirection survives restarts (recovery
+    # swaps the job object).
+    pause_ref = {"job": job}
+    _pause_when = lambda: pause_ref["job"].overload_level() >= 2  # noqa: E731
     events, producer_sinks = connect_kafka(
         flags["kafkaBrokers"], tracker=tracker,
         retry=connect_retry, send_retry=send_retry,
+        pause_when=_pause_when,
     )
     # mutable attempt state: each restart swaps in the recovered job and
     # the reconnected clients for the next with_backoff attempt
@@ -360,10 +373,12 @@ def _run_kafka(job: StreamJob, flags: Dict[str, str]) -> int:
             tracker=tracker,
             retry=connect_retry,
             send_retry=send_retry,
+            pause_when=_pause_when,
         )
         state.update(
             job=new_job, events=new_events, sinks=new_sinks, tracker=tracker
         )
+        pause_ref["job"] = new_job
 
     try:
         # fixed-delay restart strategy over the whole live loop —

@@ -86,6 +86,18 @@ class Statistics:
     serve_latency_p50_ms: float = 0.0
     serve_latency_p99_ms: float = 0.0
     serve_latency_p999_ms: float = 0.0
+    # overload-control counters (runtime/overload.py; zero with the plane
+    # unarmed, the default): forecasts shed with explicit reason-coded
+    # dead-letter entries instead of queueing (CRITICAL pressure,
+    # over-limit tenant), training rows deferred behind healthy tenants'
+    # work (ELEVATED pressure), the worst pressure level the pipeline's
+    # spokes reached (a GAUGE: 0 OK / 1 ELEVATED / 2 CRITICAL,
+    # max-combined), and the p99 of enqueue->shed waits (ms, max-combined
+    # like the serve-latency percentiles)
+    forecasts_shed: int = 0
+    records_throttled: int = 0
+    pressure_level: int = 0
+    shed_latency_ms: float = 0.0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -108,9 +120,13 @@ class Statistics:
         records_quarantined: int = 0,
         forecasts_served: int = 0,
         cohort_shards: int = 0,
+        forecasts_shed: int = 0,
+        records_throttled: int = 0,
+        pressure_level: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127).
-        ``cohort_shards`` is a gauge: max-combined, not summed."""
+        ``cohort_shards`` and ``pressure_level`` are gauges: max-combined,
+        not summed."""
         self.models_shipped += models_shipped
         self.bytes_shipped += bytes_shipped
         self.num_of_blocks += num_of_blocks
@@ -125,6 +141,9 @@ class Statistics:
         self.records_quarantined += records_quarantined
         self.forecasts_served += forecasts_served
         self.cohort_shards = max(self.cohort_shards, cohort_shards)
+        self.forecasts_shed += forecasts_shed
+        self.records_throttled += records_throttled
+        self.pressure_level = max(self.pressure_level, pressure_level)
 
     def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
         """Fold one contributor's serving-latency percentile window in
@@ -135,6 +154,11 @@ class Statistics:
         self.serve_latency_p50_ms = max(self.serve_latency_p50_ms, p50)
         self.serve_latency_p99_ms = max(self.serve_latency_p99_ms, p99)
         self.serve_latency_p999_ms = max(self.serve_latency_p999_ms, p999)
+
+    def note_shed_latency(self, p99: float) -> None:
+        """Fold one contributor's enqueue->shed p99 in (max-combine, same
+        conservative summary as the serve-latency percentiles)."""
+        self.shed_latency_ms = max(self.shed_latency_ms, p99)
 
     def update_fitted(self, fitted: int) -> None:
         self.fitted += fitted
@@ -187,6 +211,11 @@ class Statistics:
             records_quarantined=self.records_quarantined
             + other.records_quarantined,
             forecasts_served=self.forecasts_served + other.forecasts_served,
+            forecasts_shed=self.forecasts_shed + other.forecasts_shed,
+            records_throttled=self.records_throttled
+            + other.records_throttled,
+            pressure_level=max(self.pressure_level, other.pressure_level),
+            shed_latency_ms=max(self.shed_latency_ms, other.shed_latency_ms),
             serve_latency_p50_ms=max(
                 self.serve_latency_p50_ms, other.serve_latency_p50_ms
             ),
@@ -226,6 +255,10 @@ class Statistics:
             "membersEvicted": self.members_evicted,
             "recordsQuarantined": self.records_quarantined,
             "forecastsServed": self.forecasts_served,
+            "forecastsShed": self.forecasts_shed,
+            "recordsThrottled": self.records_throttled,
+            "pressureLevel": self.pressure_level,
+            "shedLatencyMs": self.shed_latency_ms,
             "serveLatencyP50Ms": self.serve_latency_p50_ms,
             "serveLatencyP99Ms": self.serve_latency_p99_ms,
             "serveLatencyP999Ms": self.serve_latency_p999_ms,

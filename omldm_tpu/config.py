@@ -131,6 +131,31 @@ class JobConfig:
     # pipeline out of this default).
     serving: str = ""
 
+    # --- overload control (runtime/overload.py; the reference delegates
+    # overload entirely to Flink's credit-based network backpressure,
+    # SURVEY §5 — the job itself has no admission control) ---
+    # Job-wide DEFAULT overload spec applied to pipelines whose
+    # trainingConfiguration carries no "overload" table of their own,
+    # e.g. "window=64,share=2,hotHigh=48,hotCritical=160" or "on".
+    # Empty (default): nothing is armed — no controller objects exist and
+    # every route is the exact pre-plane code path. Armed, each spoke
+    # derives a pressure level (OK/ELEVATED/CRITICAL) from its queues and
+    # per-tenant admission imbalance, rate-limits tenants with
+    # count-clocked token buckets, climbs a degradation ladder (widen
+    # serving batching, relax staleness, defer over-limit tenants'
+    # training) and finally SHEDS over-limit forecasts with reason-coded
+    # dead-letter entries; the Kafka drive loops pause consumption while
+    # any spoke is CRITICAL. Per-pipeline trainingConfiguration.overload
+    # always wins (an explicit false opts a pipeline out).
+    overload: str = ""
+    # In-memory prediction/response mirror cap: StreamJob keeps every
+    # emitted prediction/response in a list for callers WITHOUT sink
+    # callbacks; with a sink attached the list is just a mirror, so it is
+    # trimmed (oldest first) beyond this many entries — a stalled/slow
+    # sink consumer can no longer grow host memory with the stream.
+    # <= 0 disables trimming.
+    emission_buffer_cap: int = 100_000
+
     # --- TPU-native knobs (no reference counterpart) ---
     # Micro-batch size per training step; records are padded + masked to this
     # fixed shape so the jitted step never recompiles.

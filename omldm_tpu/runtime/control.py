@@ -44,7 +44,10 @@ class PipelineManager:
             err = self._validate_codec(request)
             if err:
                 return err
-            return self._validate_serving(request)
+            err = self._validate_serving(request)
+            if err:
+                return err
+            return self._validate_overload(request)
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
                 return f"pipeline {request.id} does not exist"
@@ -57,7 +60,10 @@ class PipelineManager:
                 err = self._validate_codec(request)
                 if err:
                     return err
-                return self._validate_serving(request)
+                err = self._validate_serving(request)
+                if err:
+                    return err
+                return self._validate_overload(request)
             return None
         return f"unknown request type {request.request}"
 
@@ -115,6 +121,16 @@ class PipelineManager:
         from omldm_tpu.runtime.serving import validate_serving
 
         return validate_serving(request.training_configuration)
+
+    @staticmethod
+    def _validate_overload(request: Request) -> Optional[str]:
+        """Overload-control config must be deployable for the same reason
+        as the serving gate: an unknown knob or inverted threshold would
+        raise at SpokeNet construction and kill the job instead of
+        dropping the one bad request."""
+        from omldm_tpu.runtime.overload import validate_overload
+
+        return validate_overload(request.training_configuration)
 
     def admit(self, request: Request) -> bool:
         """Validate + update the live map; True if the request should be

@@ -70,9 +70,14 @@ class DeadLetterSink:
         payload: Any,
         reason: str,
         detail: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
     ) -> dict:
         """Record one rejected input. Returns the entry (for callers that
-        log or publish it further). Never raises."""
+        log or publish it further). Never raises. ``extra`` merges
+        additional machine-readable fields into the entry — the overload
+        plane's ``shed_overload``/``throttled`` entries carry the
+        originating tenant and queue depth this way (the reserved keys
+        stream/reason/payload/detail are never overwritten)."""
         if isinstance(payload, bytes):
             payload = payload.decode("utf-8", errors="replace")
         elif not isinstance(payload, str):
@@ -87,6 +92,9 @@ class DeadLetterSink:
         }
         if detail:
             entry["detail"] = detail
+        if extra:
+            for k, v in extra.items():
+                entry.setdefault(k, v)
         self.entries.append(entry)
         if stream == self._request_stream:
             self.request_count += 1

@@ -195,12 +195,47 @@ class DistributedStreamJob:
         self.response_merger = ResponseMerger(self.responses.append)
         self.orphan_predictions: List[Tuple[int, float]] = []
         self.start_time = time.time()
+        # overload control (runtime/overload.py; --overload / JobConfig):
+        # on the distributed engine the honest backlog signal is the
+        # host-side staging (pending/forecast buffers + SSP-requeued
+        # rows), and the action is SOURCE BACKPRESSURE — _drive_kafka
+        # pauses this process's data partitions while the backlog is past
+        # backlogCritical. None (default) = unarmed, zero-cost.
+        from omldm_tpu.runtime.overload import parse_overload_spec
+
+        self.overload_cfg = parse_overload_spec(
+            getattr(config, "overload", "") or ""
+        )
         self._ckpt_seq = 0
         self._reduce_jits: Dict[Tuple[str, int], Any] = {}
         self._loss_mean_jit = None
 
     def _warn(self, msg: str) -> None:
         print(f"[distributed p{self.pid}] {msg}", file=sys.stderr)
+
+    # --- overload control (runtime/overload.py) ---
+
+    def backlog_rows(self) -> int:
+        """Host-side staging backlog on THIS process: rows buffered ahead
+        of the collective step (pending + forecast buffers) plus rows the
+        SSP bound refused and requeued."""
+        return int(sum(
+            p.pend_n + p.fore_n + getattr(p.trainer, "requeued_rows", 0)
+            for p in self.pipelines.values()
+        ))
+
+    def overload_level(self) -> int:
+        """0 OK / 1 ELEVATED / 2 CRITICAL from the staging backlog (the
+        distributed engine's pressure signal); 0 when unarmed."""
+        cfg = self.overload_cfg
+        if cfg is None:
+            return 0
+        backlog = self.backlog_rows()
+        if backlog >= cfg.backlog_critical:
+            return 2
+        if backlog >= cfg.backlog_high:
+            return 1
+        return 0
 
     def _fetch_replicated(self, arr) -> np.ndarray:
         """Host copy of a REPLICATED global array: read the local shard
@@ -1930,6 +1965,13 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
     startup_limit = int(flags.get("startupIdleWindows", "600"))
     # restores count as deployed: the manifest already rebuilt pipelines
     ever_deployed = bool(job.pipelines)
+    # upstream backpressure (runtime/overload.py): while this process's
+    # staging backlog is past backlogCritical its DATA partitions pause —
+    # records wait in the broker (offsets uncommitted, replayable) while
+    # pump() drains the backlog; the requests consumer never pauses (the
+    # control plane must keep flowing). State is per process.
+    data_paused = [False]
+    overload_armed = job.overload_cfg is not None
     while True:
         # 1. control plane: new request lines, broadcast to everyone
         req_lines: List[str] = []
@@ -1952,6 +1994,31 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         # process keeps issuing the same collectives)
         if undiscovered:
             _assign_partitions(retries=1)
+            # a re-assign rebuilds the consumer's partition state and
+            # silently DROPS any standing pause (kafka-python semantics)
+            # — mark the valve open so the block below re-issues the
+            # pause immediately while the level is still CRITICAL
+            data_paused[0] = False
+        # 1c. overload backpressure valve (pause/resume are best-effort:
+        # test fakes without the kafka-python API just skip the pause and
+        # rely on the chunk_rows poll bound)
+        if overload_armed and assigned:
+            level = job.overload_level()
+            if level >= 2 and not data_paused[0]:
+                pause = getattr(consumer, "pause", None)
+                if pause is not None:
+                    pause(*assigned)
+                    data_paused[0] = True
+                    job._warn(
+                        f"overload CRITICAL (backlog {job.backlog_rows()} "
+                        "rows): pausing data consumption"
+                    )
+            elif level < 2 and data_paused[0]:
+                resume = getattr(consumer, "resume", None)
+                if resume is not None:
+                    resume(*assigned)
+                data_paused[0] = False
+                job._warn("overload cleared: resuming data consumption")
         # 2. data: drain this window's records from the assigned
         # partitions. Record values are ACCUMULATED into one line buffer
         # per topic and parsed with a single bulk C call per topic per
@@ -2003,6 +2070,16 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         globally_quiet = job._collective_reduce(
             [float(had_rows + len(req_lines))], "sum"
         )[0] == 0
+        if overload_armed:
+            # a backpressure PAUSE must not count toward the idle
+            # termination bound — the fleet is overloaded, not done.
+            # Collective-agreed (every process issues the reduce, armed
+            # is config-identical) so the break decision stays lockstep.
+            any_paused = job._collective_reduce(
+                [float(data_paused[0])], "max"
+            )[0] > 0
+            if any_paused:
+                globally_quiet = False
         ever_deployed = ever_deployed or bool(job.pipelines)
         if globally_quiet:
             idle_windows += 1

@@ -70,6 +70,11 @@ class StreamJob:
         from omldm_tpu.runtime.serving import parse_serving_spec
 
         parse_serving_spec(self.config.serving)
+        # ... and the same fail-fast for a malformed job-wide overload
+        # default (runtime/overload.py)
+        from omldm_tpu.runtime.overload import parse_overload_spec
+
+        parse_overload_spec(getattr(self.config, "overload", ""))
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         # dead-letter quarantine: malformed / validation-rejected records
         # and requests land here with reason codes instead of vanishing
@@ -88,9 +93,14 @@ class StreamJob:
         # itself per pipeline to survive it. Unarmed: both attributes stay
         # None and every route is the exact pre-chaos code path.
         self._chaos_up = self._chaos_down = None
+        # seeded burst / hot-tenant injector (the overload plane's chaos
+        # driver): armed by the burst keys of the same chaos spec; None
+        # otherwise
+        self._burst = None
         spec_str = channel_chaos_spec(self.config)
         if spec_str:
             from omldm_tpu.runtime.supervisor import (
+                BurstInjector,
                 ChaosChannel,
                 parse_chaos_spec,
             )
@@ -102,6 +112,7 @@ class StreamJob:
             self._chaos_down = ChaosChannel.from_spec(
                 self._reply_to_spoke, spec, "down", name="hub>spoke"
             )
+            self._burst = BurstInjector.from_spec(spec)
         send_to_hub = (
             self._chaos_up.send if self._chaos_up is not None
             else self.hub_manager.route
@@ -116,9 +127,14 @@ class StreamJob:
                 on_poll=self.stats.mark_activity,
                 note_wire=self._note_wire,
                 emit_predictions=self._emit_predictions,
+                quarantine=self.dead_letter.quarantine,
+                tenant_routing=self._burst is not None,
             )
             for i in range(self.config.parallelism)
         ]
+        # in-memory mirror trim counters (see _trim_emission)
+        self.predictions_trimmed = 0
+        self.responses_trimmed = 0
         self._rr = 0  # round-robin data partitioner (the reference rebalances)
         self._pending_creates: List[Request] = []  # awaiting dim inference
         self._dims: dict = {}  # network_id -> feature dim
@@ -173,10 +189,24 @@ class StreamJob:
         if on_performance is not None:
             self._on_performance = on_performance
 
+    def _trim_emission(self, buf: list, counter: str) -> None:
+        """Bound the in-memory prediction/response mirrors. With a sink
+        callback attached the lists are only mirrors (every entry already
+        reached the sink), so beyond ``emission_buffer_cap`` the OLDEST
+        entries drop — a stalled/slow sink consumer can no longer grow
+        host memory with the stream. Without a sink the list IS the
+        job's output and stays unbounded."""
+        cap = getattr(self.config, "emission_buffer_cap", 0)
+        if cap > 0 and len(buf) > cap:
+            drop = len(buf) - cap
+            del buf[:drop]
+            setattr(self, counter, getattr(self, counter) + drop)
+
     def _emit_prediction(self, pred: Prediction) -> None:
         self.predictions.append(pred)
         if self._on_prediction:
             self._on_prediction(pred)
+            self._trim_emission(self.predictions, "predictions_trimmed")
 
     def _emit_predictions(self, preds: List[Prediction]) -> None:
         """Bulk twin of :meth:`_emit_prediction` for the serving plane's
@@ -186,11 +216,13 @@ class StreamJob:
         if self._on_prediction:
             for pred in preds:
                 self._on_prediction(pred)
+            self._trim_emission(self.predictions, "predictions_trimmed")
 
     def _emit_response(self, resp: QueryResponse) -> None:
         self.responses.append(resp)
         if self._on_response:
             self._on_response(resp)
+            self._trim_emission(self.responses, "responses_trimmed")
 
     def _emit_performance(self, report: JobStatistics) -> None:
         self.performance.append(report)
@@ -251,6 +283,8 @@ class StreamJob:
             return
         if counter == "serve_latency_ms":
             hub.node.stats.note_serve_latency(*n)
+        elif counter == "shed_latency_ms":
+            hub.node.stats.note_shed_latency(n)
         else:
             hub.node.stats.update_stats(**{counter: n})
 
@@ -301,6 +335,12 @@ class StreamJob:
                 if stream == FORECASTING_STREAM:
                     inst.operation = FORECASTING
                 self._handle_data(inst)
+                if self._burst is not None:
+                    # seeded burst amplification: extra tenant-addressed
+                    # copies of this forecast flood the hot tenant — the
+                    # overload plane's deterministic overload driver
+                    for clone in self._burst.clones(inst):
+                        self._handle_data(clone)
         elif stream == PACKED_STREAM:
             self.process_packed_batch(*payload)
 
@@ -471,6 +511,8 @@ class StreamJob:
                         on_poll=self.stats.mark_activity,
                         note_wire=self._note_wire,
                         emit_predictions=self._emit_predictions,
+                        quarantine=self.dead_letter.quarantine,
+                        tenant_routing=self._burst is not None,
                     )
                 )
             self.config.parallelism = n_new
@@ -631,6 +673,48 @@ class StreamJob:
         out["serve_p99_ms"] = ssum["p99_ms"]
         return out
 
+    # --- overload control (runtime/overload.py) --------------------------
+
+    def overload_level(self) -> int:
+        """The job's pressure level: the MAX over every spoke's overload
+        controller (0 = OK when none is armed). The Kafka drive loops
+        read this to pause consumption while any spoke is CRITICAL —
+        unconsumed offsets stay uncommitted, so paused traffic is
+        replayable rather than buffered (Flink's credit-based
+        backpressure, moved into the runtime)."""
+        level = 0
+        for spoke in self.spokes:
+            if spoke.overload is not None and spoke.overload.level > level:
+                level = spoke.overload.level
+        return level
+
+    def overload_idle_tick(self) -> None:
+        """Advance every controller's count clock during source idle /
+        pause windows: nothing admits while paused, so without idle
+        ticks the buckets would never refill and a CRITICAL pause could
+        never clear (see OverloadController.idle_tick)."""
+        for spoke in self.spokes:
+            if spoke.overload is not None:
+                spoke.overload.idle_tick()
+                # idle capacity also drains deferred rows / sheds settle
+                spoke._overload_tick()
+
+    def queue_depths(self) -> dict:
+        """Aggregate queue-depth snapshot across every spoke (the uniform
+        accessors of runtime/spoke.Spoke.queue_depths) + the job-level
+        pre-deploy backlog and the current pressure level — folded into
+        tenant_topology() and every protocol_comparison results row."""
+        agg: dict = {
+            "serving": 0, "batcher": 0, "throttled": 0, "paused": 0,
+            "pre_create": 0,
+        }
+        for spoke in self.spokes:
+            for k, v in spoke.queue_depths().items():
+                agg[k] += v
+        agg["backlog"] = len(self._backlog)
+        agg["pressure_level"] = self.overload_level()
+        return agg
+
     def tenant_topology(self) -> dict:
         """Where the co-hosted tenants actually run: the local device
         count, the widest engaged tenant-mesh shard count, and each live
@@ -643,6 +727,10 @@ class StreamJob:
             "devices": jax.local_device_count(),
             "cohort_shards": 1,
             "placement": [],
+            # live queue depths + pressure level ride the topology report
+            # so BENCH rounds see WHERE work is waiting, not just where
+            # tenants run
+            "queues": self.queue_depths(),
         }
         for spoke in self.spokes:
             engine = spoke.cohorts

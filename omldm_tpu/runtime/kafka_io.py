@@ -84,6 +84,8 @@ def polling_events(
     consumer: Any,
     topic_map: Optional[Mapping[str, str]] = None,
     tracker: Optional[dict] = None,
+    pause_when: Optional[Any] = None,
+    pause_sleep_s: float = 0.05,
 ) -> Iterator[Optional[Tuple[str, str]]]:
     """Adapt a poll-style Kafka consumer into a NEVER-ENDING event iterable
     that yields ``None`` whenever a poll window elapses with no message.
@@ -98,9 +100,24 @@ def polling_events(
     ``(topic, partition)`` as records are consumed — the source-position
     side of a checkpoint (what a Flink checkpoint barrier snapshots from
     its Kafka sources), enabling seek-and-replay recovery. Records without
-    an ``offset`` attribute advance a per-partition counter instead."""
+    an ``offset`` attribute advance a per-partition counter instead.
+
+    ``pause_when`` (a nullary callable) is the UPSTREAM BACKPRESSURE
+    valve: while it returns True — the overload controller reporting
+    CRITICAL pressure (``StreamJob.overload_level()``) — no record is
+    consumed; the loop sleeps briefly and yields idle markers so the
+    driver keeps running its silence/recovery ticks. Unconsumed records'
+    offsets are never tracked, so paused traffic is REPLAYABLE (the
+    at-least-once posture of Flink's credit-based backpressure) instead
+    of buffered into host memory."""
+    import time as _time
+
     topic_map = dict(topic_map or DEFAULT_TOPICS)
     while True:
+        if pause_when is not None and pause_when():
+            _time.sleep(pause_sleep_s)
+            yield None
+            continue
         try:
             record = next(consumer)
         except StopIteration:
@@ -241,6 +258,7 @@ def connect_kafka(
     tracker: Optional[dict] = None,
     retry: Optional[BackoffPolicy] = None,
     send_retry: Optional[BackoffPolicy] = None,
+    pause_when: Optional[Any] = None,
 ) -> Tuple[Iterator[Optional[Tuple[str, str]]], "ProducerSinks"]:
     """Wire real Kafka clients. Requires kafka-python or confluent_kafka;
     raises ImportError with guidance otherwise (neither library ships in
@@ -408,7 +426,10 @@ def connect_kafka(
         ],
     )
     return (
-        polling_events(chaos_consumer, topic_map, tracker=tracker),
+        polling_events(
+            chaos_consumer, topic_map, tracker=tracker,
+            pause_when=pause_when,
+        ),
         ProducerSinks(
             producer, out_topics, consumer=consumer, retry=send_retry
         ),

@@ -236,6 +236,16 @@ def _entry_rows(x) -> int:
     return 1
 
 
+def _limits(net) -> ServingConfig:
+    """The EFFECTIVE serving limits for ``net``: its static config, or the
+    overload controller's degraded (widened/relaxed) variant while the
+    spoke is under pressure (runtime/overload.py — the degradation
+    ladder's serving rung). Nets without the accessor (unit-test stubs)
+    and overload-unarmed nets always get the static config."""
+    get = getattr(net, "serving_limits", None)
+    return get() if get is not None else net.serving
+
+
 class ServingPlane:
     """Per-spoke queue manager: admission, flush triggers, batched
     emission, latency accounting. One instance per Spoke, created when the
@@ -265,8 +275,11 @@ class ServingPlane:
         # flush aligned, in one gang launch
         self._fill = False
 
-    @property
     def queued(self) -> int:
+        """Total forecast rows pending across every net's queue — the
+        uniform queue-depth accessor (MicroBatcher.queued(),
+        Prefetcher.queued() follow the same contract) and one of the
+        overload controller's pressure signals."""
         return sum(n.serve_queue.n_rows for n in self._pending.values())
 
     # --- admission -------------------------------------------------------
@@ -281,7 +294,7 @@ class ServingPlane:
             self._pending[net.request.id] = net
         q.entries.append((inst, x, now))
         q.n_rows += 1
-        if q.n_rows >= net.serving.max_batch:
+        if q.n_rows >= _limits(net).max_batch:
             self._fill = True
 
     def admit_rows(self, net, rows: np.ndarray, now: float) -> None:
@@ -299,7 +312,7 @@ class ServingPlane:
             self._pending[net.request.id] = net
         q.entries.append((None, rows, now))
         q.n_rows += rows.shape[0]
-        if q.n_rows >= net.serving.max_batch:
+        if q.n_rows >= _limits(net).max_batch:
             self._fill = True
 
     # --- flush triggers --------------------------------------------------
@@ -314,7 +327,7 @@ class ServingPlane:
         self._fill = False
         for net in list(self._pending.values()):
             q = net.serve_queue
-            if q.entries and q.n_rows >= net.serving.max_batch:
+            if q.entries and q.n_rows >= _limits(net).max_batch:
                 self.flush_group(self._group(net))
 
     def poll(self, now: Optional[float] = None) -> None:
@@ -326,7 +339,7 @@ class ServingPlane:
         now = self._clock() if now is None else now
         for net in list(self._pending.values()):
             q = net.serve_queue
-            if q.entries and (now - q.t_oldest) * 1000.0 >= net.serving.max_delay_ms:
+            if q.entries and (now - q.t_oldest) * 1000.0 >= _limits(net).max_delay_ms:
                 self.flush_group(self._group(net))
 
     def fence(self, net, chunks: int = 1) -> None:
@@ -346,7 +359,7 @@ class ServingPlane:
         q = net.serve_queue
         if not q.entries:
             return
-        cfg = net.serving
+        cfg = _limits(net)
         if cfg.staleness == "exact" or q.chunks >= cfg.stale_chunks:
             self.flush_group(self._group(net))
         else:
@@ -365,6 +378,18 @@ class ServingPlane:
         while self._pending:
             _, net = next(iter(self._pending.items()))
             self.flush_group(self._group(net))
+
+    def take_queue(self, net) -> Tuple[List[tuple], int]:
+        """Remove and return one net's pending entries WITHOUT serving
+        them — the overload controller's CRITICAL shed path drains an
+        over-limit tenant's queue through here and answers each entry
+        with a reason-coded dead-letter record instead of a prediction."""
+        q = net.serve_queue
+        entries, q.entries = q.entries, []
+        n_rows, q.n_rows = q.n_rows, 0
+        q.chunks = 0
+        self._pending.pop(net.request.id, None)
+        return entries, n_rows
 
     # --- flush execution -------------------------------------------------
 

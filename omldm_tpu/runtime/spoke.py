@@ -43,10 +43,17 @@ from omldm_tpu.runtime.messages import (
     channel_window_size,
     reliability_armed,
 )
+from omldm_tpu.runtime.overload import (
+    CRITICAL,
+    ELEVATED,
+    OverloadController,
+    overload_config,
+)
 from omldm_tpu.runtime.serving import (
     ServeStats,
     ServeQueue,
     ServingPlane,
+    _entry_rows,
     serving_config,
 )
 from omldm_tpu.runtime.vectorizer import (
@@ -195,6 +202,14 @@ class SpokeNet:
         self.serve_queue = ServeQueue()
         self.serve_stats = ServeStats()
         self._plane: Optional[ServingPlane] = None
+        # overload-control plane (trainingConfiguration.overload /
+        # JobConfig.overload): when armed, this tenant's admissions run
+        # through the spoke's OverloadController (fair-share token
+        # bucket, degradation ladder, load shedding; runtime/overload.py);
+        # None (default) keeps the exact pre-plane routes. The controller
+        # reference is attached by the hosting Spoke at create time.
+        self.overload = overload_config(tc, getattr(config, "overload", ""))
+        self._octl: Optional[OverloadController] = None
         # persistent padded predict scratch: the per-record, gang and
         # batched serve paths all pad rows into this reused buffer instead
         # of allocating a fresh pad batch per forecast record
@@ -278,6 +293,17 @@ class SpokeNet:
         self._scratch_dirty = n
         return self._scratch[:b]
 
+    def serving_limits(self):
+        """The serving config the flush triggers compare against: the
+        static config, or — while the spoke's overload controller reports
+        pressure — its degraded variant (widened maxBatch/maxDelayMs,
+        relaxed staleness: the ladder's serving rung). Overload-unarmed
+        nets always get the static config, bit-identically."""
+        ctl = self._octl
+        if ctl is None or ctl.level == 0:
+            return self.serving
+        return ctl.degraded_serving(self)
+
     def gang_predict_ok(self) -> bool:
         """Gang forecast serving bypasses ``node.on_forecast_batch`` with a
         bit-identical batched predict — only valid for attached dense nets
@@ -360,6 +386,15 @@ class Spoke:
         emit_predictions: Optional[
             Callable[[List[Prediction]], None]
         ] = None,
+        # dead-letter hook (stream, payload, reason, detail=, extra=):
+        # the overload plane's shed/throttle records quarantine through
+        # it with reason codes instead of vanishing
+        quarantine: Optional[Callable] = None,
+        # opt-in for metadata.tenant record addressing even with the
+        # overload plane unarmed (the job sets it when the chaos burst
+        # injector is armed — its clones are tenant-addressed); False =
+        # metadata-carrying records broadcast exactly as pre-plane
+        tenant_routing: bool = False,
     ):
         self.worker_id = worker_id
         self.config = config
@@ -403,6 +438,12 @@ class Spoke:
         # so serving-unset jobs pay one attribute read
         self.serving_plane: Optional[ServingPlane] = None
         self._any_serving = False
+        # overload controller (runtime/overload.py): created on the first
+        # overload-armed net; None (default) = no admission accounting,
+        # no ladder, no shedding — one attribute read on the data paths
+        self.overload: Optional[OverloadController] = None
+        self._quarantine = quarantine
+        self.tenant_routing = tenant_routing
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
@@ -439,6 +480,10 @@ class Spoke:
         net.node.on_start()
         if net.serving is not None:
             net._plane = self._ensure_serving_plane()
+        if net.overload is not None:
+            if self.overload is None:
+                self.overload = OverloadController(self)
+            self.overload.arm(net)
         if net.pipeline.guard is not None:
             self._any_guard = True
             # seed the first last-known-good snapshot at the init params:
@@ -498,6 +543,10 @@ class Spoke:
             # cohort churn: the member's slot frees for reuse (compaction),
             # no recompile; survivors keep their slots untouched
             self.cohorts.retire(net.pipeline)
+        if net is not None and self.overload is not None:
+            # the tenant's accounting (and any deferred rows) go with it,
+            # like the net's pause buffer does
+            self.overload.retire(network_id)
         # a deleted net can no longer generate the hub RPCs that toggle its
         # siblings: resume + drain any survivor left paused, or it would
         # starve until the terminate probe
@@ -525,8 +574,63 @@ class Spoke:
         if not self.nets:
             self.record_buffer.append(inst)
             return
+        nets = self.nets.values()
+        meta = inst.metadata
+        if isinstance(meta, dict) and (
+            self.overload is not None or self.tenant_routing
+        ):
+            # tenant-ADDRESSED record: ``metadata.tenant`` names a hosted
+            # pipeline and the record routes to it ALONE instead of
+            # fanning out — the per-tenant traffic shape the overload
+            # plane's fairness accounting (and its burst injector)
+            # exercises. OPT-IN: only an armed overload controller or the
+            # burst injector (job-level ``tenant_routing``) activates the
+            # route, so pre-existing streams whose metadata happens to
+            # carry a "tenant" key keep the exact pre-plane broadcast
+            # fan-out. Non-dict metadata (a string/list the validation
+            # boundary admits and the reference ignores) never routes.
+            # An unknown tenant falls back to the broadcast fan-out;
+            # records without the key are untouched.
+            target = self.nets.get(meta.get("tenant"))
+            if target is not None:
+                nets = (target,)
+        ctl = self.overload
         serve_entries: List[Tuple[SpokeNet, Any]] = []
-        for net in self.nets.values():
+        # False only when EVERY admission this record attempted was shed
+        # (a flooded tenant-addressed record): nothing entered a queue,
+        # so the boundary's serving poll can wait for the next admitted
+        # record — shedding must stay far cheaper than serving
+        touched = ctl is None
+        for net in nets:
+            if (
+                ctl is not None
+                and net.overload is not None
+                and not net.node.paused
+            ):
+                # fair-share admission: the counter accounts every row;
+                # the LEVEL gates what an over-limit verdict does — shed
+                # forecasts only at CRITICAL, defer training at ELEVATED+.
+                # Runs BEFORE featurization: a shed record must cost as
+                # close to nothing as the runtime can manage
+                over = ctl.spend(net, 1)
+                if over and ctl.level >= ELEVATED:
+                    if inst.operation == FORECASTING:
+                        if ctl.level >= CRITICAL and net.overload.shed:
+                            self._shed_forecast(net, inst)
+                            continue
+                    else:
+                        self._defer_training(
+                            net,
+                            (
+                                inst.operation,
+                                net.vectorizer.vectorize(inst),
+                                inst.target,
+                                None,
+                            ),
+                            1,
+                        )
+                        touched = True
+                        continue
             x = net.vectorizer.vectorize(inst)
             if net.node.paused:
                 # hold, don't drop: the net resumes on the next toggle.
@@ -537,6 +641,7 @@ class Spoke:
                 net.pause_buffer.append(
                     (inst.operation, x, inst.target, held_inst)
                 )
+                touched = True
                 continue
             if inst.operation == FORECASTING:
                 # collect, then serve below: cohort members answer through
@@ -544,14 +649,27 @@ class Spoke:
                 serve_entries.append((net, x))
             else:
                 self._train(net, x, 0.0 if inst.target is None else inst.target)
+                touched = True
         if serve_entries:
+            touched = True
             self._serve_many(inst, serve_entries)
         # gang barrier: launch every cohort's staged fits for this record
         self._flush_cohorts()
         # guard: evaluate the health results this record's launches noted
         self._guard_tick_all()
-        # serving plane: fill-aligned flushes + the maxDelayMs deadline
-        self.poll_serving()
+        # overload: re-derive the pressure level from the queues this
+        # record left behind, shed/drain accordingly (one flag read
+        # unarmed) — BEFORE the serving poll so degraded limits apply at
+        # this boundary. Fully-shed records skip BOTH boundary walks
+        # (their spends already advanced the count clock; the next
+        # admitted record's tick sees them): shedding must cost as close
+        # to nothing as the runtime can manage, or the flood's processing
+        # overhead would itself degrade healthy tenants
+        if touched:
+            if ctl is not None:
+                self._overload_tick()
+            # serving plane: fill-aligned flushes + maxDelayMs deadline
+            self.poll_serving()
         if inst.operation != FORECASTING:
             # poll marker every 100 training records — once per record, not
             # per hosted pipeline (FlinkSpoke.scala:83-89)
@@ -583,12 +701,21 @@ class Spoke:
             self._packed_buffer.append(("__packed__", (x, y, op), None, None))
             return
         f_idx = np.nonzero(op != 0)[0]
+        ctl = self.overload
         gang_nets: List[SpokeNet] = []
         for net in self.nets.values():
             if net.node.paused:
                 # hold the whole block; drains via _drain_pause_buffer
                 net.pause_buffer.append(("__packed__", (x, y, op), None, None))
                 continue
+            if ctl is not None and net.overload is not None:
+                # block-granular admission (like pause): an over-limit
+                # tenant under pressure sheds/serves its forecast rows
+                # and defers its training rows for this whole block
+                over = ctl.spend(net, n)
+                if over and ctl.level >= ELEVATED:
+                    self._overload_packed(net, x, y, op, f_idx)
+                    continue
             if net.pipeline._cohort is not None:
                 # cohort members advance in LOCKSTEP below so same-cohort
                 # flushes stage into shared gang launches (per-net row
@@ -604,6 +731,8 @@ class Spoke:
             self._process_packed_gang(gang_nets, x, y, f_idx)
         self._flush_cohorts()
         self._guard_tick_all()
+        if ctl is not None:
+            self._overload_tick()
         self.poll_serving()
         nt = n - int(f_idx.size)
         if nt:
@@ -867,6 +996,23 @@ class Spoke:
                 net.serve_stats.percentiles(),
             )
             net.serve_stats.reset()
+        # overload telemetry: shed/throttle counts fold once (like the
+        # launch tally), the pressure level is a peak GAUGE, and the
+        # shed-wait p99 rides the same max-combine path as serve latency
+        if self._note_wire is not None and self.overload is not None:
+            ctl = self.overload
+            nid = net.request.id
+            shed = ctl.take_shed(nid)
+            if shed:
+                self._note_wire(nid, 0, "forecasts_shed", shed)
+                p99 = ctl.shed_latency_p99(nid)
+                if p99:
+                    self._note_wire(nid, 0, "shed_latency_ms", p99)
+            throttled = ctl.take_throttled(nid)
+            if throttled:
+                self._note_wire(nid, 0, "records_throttled", throttled)
+            if ctl.level_peak:
+                self._note_wire(nid, 0, "pressure_level", ctl.level_peak)
         desc = net.pipeline.describe()
         qstats = net.node.query_stats()
 
@@ -911,6 +1057,10 @@ class Spoke:
             if net.node.paused:
                 net.node.paused = False
             self._drain_pause_buffer(net)
+            if self.overload is not None:
+                # deferred (throttled) rows train before the final
+                # evaluation: deprioritized work is late, never lost
+                self._drain_throttled(net)
             net.flush_batch()
             self._flush_cohorts()
             net.node.on_flush()
@@ -1008,6 +1158,162 @@ class Spoke:
             prev = f + 1
         if prev < n:
             self._train_packed(net, x[prev:], y[prev:])
+
+    # --- overload-control plane (runtime.overload) -----------------------
+
+    def _overload_tick(self) -> None:
+        """Pressure re-derivation + the level-transition actions: entering
+        CRITICAL sheds over-limit tenants' QUEUED forecasts (they would
+        otherwise serve through a saturated plane after sitting out the
+        whole episode); recovered tenants (and everyone at OK) drain
+        their deferred training rows back into the stream."""
+        ctl = self.overload
+        old, new = ctl.tick()
+        if new >= CRITICAL and old < CRITICAL and self.serving_plane is not None:
+            for net in list(self.nets.values()):
+                if (
+                    net.overload is not None
+                    and net.overload.shed
+                    and net.serving is not None
+                    and net.serve_queue.entries
+                    and ctl.is_over(net.request.id)
+                ):
+                    self._shed_queued(net)
+        for nid in ctl.drainable():
+            net = self.nets.get(nid)
+            if net is not None and not net.node.paused:
+                self._drain_throttled(net)
+
+    def _quarantine_shed(self, net: SpokeNet, payload, depth: int) -> None:
+        if self._quarantine is not None:
+            # an explicit SHED record — reason-coded, carrying the
+            # originating tenant and its queue depth — instead of a
+            # silent timeout (stream name matches the job's forecasting
+            # stream so dead-letter accounting counts it as a record)
+            self._quarantine(
+                "forecastingData", payload, "shed_overload",
+                extra={"tenant": net.request.id, "queueDepth": depth},
+            )
+
+    def _shed_forecast(self, net: SpokeNet, inst: DataInstance) -> None:
+        """Admission-time shed of one forecasting record (CRITICAL level,
+        over-limit tenant): zero wait — the record is refused before it
+        queues, so it contributes no shed-latency sample. The quarantine
+        payload stays COMPACT (a preformatted row count, not the feature
+        vector): shedding must be far cheaper than serving, and overload
+        sheds reject volume, not malformed content worth archiving."""
+        self.overload.note_shed(net.request.id, 1)
+        self._quarantine_shed(
+            net, "rows=1 source=admission", net.serve_queue.n_rows
+        )
+
+    def _shed_packed(self, net: SpokeNet, f_idx: np.ndarray) -> None:
+        """Admission-time shed of a packed block's forecast rows."""
+        rows = int(f_idx.size)
+        self.overload.note_shed(net.request.id, rows)
+        self._quarantine_shed(
+            net, {"rows": rows, "source": "packed"}, net.serve_queue.n_rows
+        )
+
+    def _shed_queued(self, net: SpokeNet) -> None:
+        """CRITICAL-entry shed of a tenant's ALREADY-QUEUED forecasts;
+        each entry's enqueue->shed wait feeds the shedLatencyMs
+        percentile."""
+        depth = net.serve_queue.n_rows
+        entries, n_rows = self.serving_plane.take_queue(net)
+        if not entries:
+            return
+        ctl = self.overload
+        now = ctl.now()
+        for inst, x, t0 in entries:
+            k = 1 if inst is not None else _entry_rows(x)
+            ctl.note_shed(net.request.id, k, (now - t0) * 1000.0)
+        self._quarantine_shed(
+            net, {"rows": n_rows, "source": "queue"}, depth
+        )
+
+    def _overload_packed(
+        self, net: SpokeNet, x, y, op, f_idx: np.ndarray
+    ) -> None:
+        """An over-limit tenant's share of a packed block under pressure:
+        forecasts shed at CRITICAL (served normally at ELEVATED — only
+        training deprioritizes there), training rows defer behind healthy
+        tenants' work."""
+        ctl = self.overload
+        if f_idx.size:
+            if ctl.level >= CRITICAL and net.overload.shed:
+                self._shed_packed(net, f_idx)
+            else:
+                self._serve_packed(net, x, f_idx)
+        t_idx = np.nonzero(op == 0)[0]
+        if t_idx.size:
+            entry = (
+                "__packed__",
+                (x[t_idx], y[t_idx], np.zeros((t_idx.size,), np.uint8)),
+                None, None,
+            )
+            self._defer_training(net, entry, int(t_idx.size))
+
+    def _defer_training(self, net: SpokeNet, entry: tuple, rows: int) -> None:
+        """Deprioritize an over-limit tenant's training rows into its
+        bounded deferral ring (drained when the tenant recovers, pressure
+        clears, or the terminate probe fires); ring overflow — the
+        oldest rows dropping — is quarantined with reason ``throttled``
+        rather than lost silently."""
+        ctl = self.overload
+        nid = net.request.id
+        buf = ctl.deferred.get(nid)
+        if buf is None:
+            buf = ctl.deferred[nid] = _PauseBuffer(net.overload.defer_cap)
+        before = len(buf)
+        buf.append(entry)
+        ctl.note_throttled(nid, rows)
+        evicted = before + rows - len(buf)
+        if evicted > 0 and self._quarantine is not None:
+            self._quarantine(
+                "trainingData", {"rows": evicted}, "throttled",
+                extra={"tenant": nid, "queueDepth": len(buf)},
+            )
+
+    def _drain_throttled(self, net: SpokeNet) -> None:
+        """Re-admit a tenant's deferred training rows (no re-spend: the
+        rows were accounted when they arrived)."""
+        ctl = self.overload
+        if ctl is None:
+            return
+        buf = ctl.deferred.get(net.request.id)
+        if buf is None or buf.is_empty:
+            return
+        for operation, x, target, _inst in buf.drain():
+            if operation == "__packed__":
+                px, py, pop = x
+                self._process_packed_for_net(
+                    net, px, py, np.nonzero(pop != 0)[0]
+                )
+            else:
+                self._train(net, x, 0.0 if target is None else target)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Uniform queue-depth snapshot for this spoke — the accessors the
+        overload controller reads as pressure signals, folded into
+        ``StreamJob.tenant_topology()`` and the benchmark result rows."""
+        return {
+            "serving": (
+                self.serving_plane.queued()
+                if self.serving_plane is not None else 0
+            ),
+            "batcher": int(
+                sum(net.batcher.queued() for net in self.nets.values())
+            ),
+            "throttled": (
+                self.overload.backlog_rows()
+                if self.overload is not None else 0
+            ),
+            "paused": int(
+                sum(len(net.pause_buffer) for net in self.nets.values())
+            ),
+            "pre_create": len(self.record_buffer) + len(self._packed_buffer),
+        }
 
     # --- cohort gang dispatch (runtime.cohort) ---------------------------
 
@@ -1383,6 +1689,26 @@ class Spoke:
             retired.serving_plane.flush_all()
         if self.serving_plane is not None:
             self.serving_plane.flush_all()
+        if retired.overload is not None:
+            # throttled rows train into the retiring replicas BEFORE the
+            # model merge (deprioritized work must not vanish with its
+            # spoke), and un-folded shed/throttle counters carry over
+            for rnet in retired.nets.values():
+                retired._drain_throttled(rnet)
+            if self.overload is not None:
+                rctl, sctl = retired.overload, self.overload
+                for nid in list(rctl._shed):
+                    sctl._shed[nid] = (
+                        sctl._shed.get(nid, 0) + rctl.take_shed(nid)
+                    )
+                for nid in list(rctl._throttled):
+                    sctl._throttled[nid] = (
+                        sctl._throttled.get(nid, 0)
+                        + rctl.take_throttled(nid)
+                    )
+                sctl.level_peak = max(sctl.level_peak, rctl.level_peak)
+                sctl.total_shed += rctl.total_shed
+                sctl.total_throttled += rctl.total_throttled
         # settle gang state on both sides first: the retiring spoke's
         # cohorts dissolve (members get their state back for the merge);
         # survivors keep their cohorts — merge_from edits flow through the
@@ -1403,6 +1729,11 @@ class Spoke:
                     # re-home the queue plumbing: the retired spoke's plane
                     # (already flushed above) is gone with its owner
                     rnet._plane = self._ensure_serving_plane()
+                if rnet.overload is not None:
+                    # re-home the admission accounting the same way
+                    if self.overload is None:
+                        self.overload = OverloadController(self)
+                    self.overload.arm(rnet)
                 continue
             snet.shared_taint = True
             # pending rows train into the surviving replica: the batcher's

@@ -518,23 +518,35 @@ _CHAOS_PARAMS = ("drop", "dup", "reorder", "delay")
 # so pre-existing specs keep their exact seeded schedules.
 _CHAOS_CORRUPT = ("nan", "explode", "poison")
 
+# burst / hot-tenant injector keys (channel-wide, not per-direction): the
+# overload-control plane's fault drivers. ``burst=K`` amplifies every
+# forecasting record inside the window [burstFrom, burstFrom+burstLen)
+# (counted in FORECAST records) into K copies, the K-1 extras
+# tenant-addressed at ``hotTenant`` — a deterministic traffic flood at
+# one tenant that the fair-share admission must absorb without degrading
+# its gang siblings.
+_CHAOS_BURST = ("burst", "burstFrom", "burstLen", "hotTenant")
+
 
 def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
     """Parse a chaos spec string into ``{seed, window, up: {...}, down:
-    {...}}``.
+    {...}, burst...}``.
 
     Format: comma-separated ``key=value`` pairs. ``seed`` and ``window``
     are channel-wide; ``drop``/``dup``/``reorder``/``delay`` (loss
     classes) and ``nan``/``explode``/``poison`` (corruption classes) are
     probabilities applied to BOTH directions unless prefixed
     (``up.drop=0.1`` hits only worker->hub, ``down.dup=0.05`` only
-    hub->worker). Returns None for an empty/None spec; raises ValueError
-    on unknown keys so a typo'd flag fails loudly instead of running
-    fault-free."""
+    hub->worker); ``burst``/``burstFrom``/``burstLen``/``hotTenant`` arm
+    the hot-tenant burst injector (channel-wide ints). Returns None for
+    an empty/None spec; raises ValueError on unknown keys so a typo'd
+    flag fails loudly instead of running fault-free."""
     if not spec:
         return None
     base = {k: 0.0 for k in _CHAOS_PARAMS + _CHAOS_CORRUPT}
-    out: Dict = {"seed": 0, "window": 4, "up": dict(base), "down": dict(base)}
+    out: Dict = {"seed": 0, "window": 4, "up": dict(base), "down": dict(base),
+                 "burst": 0, "burstFrom": 0, "burstLen": 1 << 31,
+                 "hotTenant": 0}
     for part in str(spec).split(","):
         part = part.strip()
         if not part:
@@ -542,7 +554,7 @@ def parse_chaos_spec(spec: Optional[str]) -> Optional[Dict]:
         key, _, value = part.partition("=")
         key = key.strip()
         value = value.strip() or "0"
-        if key in ("seed", "window"):
+        if key in ("seed", "window") or key in _CHAOS_BURST:
             out[key] = int(float(value))
         elif "." in key:
             direction, _, param = key.partition(".")
@@ -638,6 +650,62 @@ def _corrupt_payload(payload, mode: str, rng):
             out["params"] = params
             return out
     return None
+
+
+class BurstInjector:
+    """Seeded hot-tenant burst injector (the overload plane's chaos
+    driver): amplifies forecasting records inside a deterministic window
+    into extra TENANT-ADDRESSED copies (``metadata.tenant``) flooding one
+    pipeline.
+
+    The schedule is a pure function of the spec and the forecast-record
+    sequence — the window is counted in forecast records and the
+    amplification factor is fixed — so the same seed/spec replays the
+    identical flood (and, downstream, the identical shed/throttle
+    schedule: the determinism pin of tests/test_overload.py). The seed
+    keys the injector's RNG stream for future stochastic classes; the
+    deterministic window keeps today's assertions exact."""
+
+    def __init__(self, factor: int, start: int = 0, length: int = 1 << 31,
+                 hot_tenant: int = 0, seed: int = 0):
+        self.factor = int(factor)
+        self.start = int(start)
+        self.length = int(length)
+        self.hot_tenant = int(hot_tenant)
+        self._rng = _chaos_rng(seed, "burst")
+        self.forecasts_seen = 0
+        self.injected = 0
+
+    @classmethod
+    def from_spec(cls, spec: Optional[Dict]) -> Optional["BurstInjector"]:
+        if not spec or int(spec.get("burst", 0)) < 2:
+            return None
+        return cls(
+            spec["burst"], spec.get("burstFrom", 0),
+            spec.get("burstLen", 1 << 31), spec.get("hotTenant", 0),
+            seed=spec.get("seed", 0),
+        )
+
+    def clones(self, inst):
+        """The K-1 extra copies of ``inst`` to inject (empty outside the
+        window / for non-forecasting records). Copies share the feature
+        payload (read-only) and carry the hot tenant's address."""
+        from omldm_tpu.api.data import FORECASTING
+
+        if inst.operation != FORECASTING:
+            return ()
+        i = self.forecasts_seen
+        self.forecasts_seen += 1
+        if not (self.start <= i < self.start + self.length):
+            return ()
+        import dataclasses as _dc
+
+        clone = _dc.replace(
+            inst, metadata={"tenant": self.hot_tenant, "burst": True}
+        )
+        k = self.factor - 1
+        self.injected += k
+        return [clone] * k
 
 
 # poisoned-record templates the record-stream injector rotates through:
@@ -942,6 +1010,7 @@ def maybe_chaos_consumer(
 
 __all__ = [
     "AttemptRecord",
+    "BurstInjector",
     "ChaosChannel",
     "ChaosConsumer",
     "DistributedFaultInjector",

@@ -136,6 +136,11 @@ class SparseMicroBatcher:
     def __len__(self) -> int:
         return self._n
 
+    def queued(self) -> int:
+        """Pending (staged, unflushed) rows — the uniform queue-depth
+        accessor (same contract as ServingPlane.queued())."""
+        return self._n
+
     @property
     def full(self) -> bool:
         return self._n >= self.batch_size
@@ -191,6 +196,11 @@ class MicroBatcher:
         self._n = 0
 
     def __len__(self) -> int:
+        return self._n
+
+    def queued(self) -> int:
+        """Pending (staged, unflushed) rows — the uniform queue-depth
+        accessor (same contract as ServingPlane.queued())."""
         return self._n
 
     @property

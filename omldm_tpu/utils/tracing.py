@@ -88,6 +88,19 @@ class StepTimer:
             "steps_per_sec": 1000.0 / mean if mean > 0 else 0.0,
         }
 
+    def recent_p99(self, window: int = 256) -> float:
+        """p99 ms over (approximately) the most recent ``window`` samples
+        — the overload controller's cheap latency signal. Reads the tail
+        of the sample ring without sorting the whole retained window;
+        ring order scrambles sample recency slightly past one wrap, which
+        is fine for a pressure signal. 0.0 when empty."""
+        import numpy as np
+
+        if not self._durations_ms:
+            return 0.0
+        tail = self._durations_ms[-min(window, len(self._durations_ms)):]
+        return float(np.percentile(np.asarray(tail), 99))
+
     def reset(self) -> None:
         self._durations_ms = []
         self._total = 0

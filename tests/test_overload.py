@@ -1,0 +1,750 @@
+"""Overload-control plane (runtime/overload.py).
+
+Pins, per ISSUE 10 acceptance:
+
+- ``overload`` unset runs the exact pre-plane routes — no controller
+  objects anywhere — across the composition matrix (cohort x codec int8
+  x guard x serving exact), and an ARMED controller under uniform
+  traffic is bit-identical to unarmed (fair-share accounting can never
+  flag uniform fan-out traffic);
+- per-tenant fair-share admission: a flooded tenant goes over limit,
+  uniform tenants never do, flags recompute at boundary ticks;
+- the pressure ladder: immediate upward transitions, ``cool``-tick
+  hysteresis downward, degraded (widened/relaxed) serving limits for
+  over-limit tenants ONLY, idle ticks decay a paused source back to OK;
+- under a seeded hot-tenant burst the hot tenant's forecasts SHED with
+  reason-coded dead letters carrying the tenant + queue depth, its
+  training rows deprioritize (and still train — late, never lost),
+  healthy tenants shed NOTHING and serve every forecast;
+- burst determinism: same seed/spec => the same shed schedule, the same
+  dead-letter stream, the same counters;
+- upstream backpressure: ``polling_events`` consumes nothing while
+  ``pause_when`` holds (offsets untracked = replayable);
+- the bounded emission mirrors, the uniform queue-depth accessors, and
+  the Statistics plumbing for the new counters.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.api.stats import Statistics
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+)
+from omldm_tpu.runtime.kafka_io import polling_events
+from omldm_tpu.runtime.overload import (
+    CRITICAL,
+    ELEVATED,
+    OK,
+    OverloadConfig,
+    OverloadController,
+    TICK_STRIDE,
+    overload_config,
+    parse_overload_spec,
+    validate_overload,
+)
+from omldm_tpu.runtime.prefetch import Prefetcher, prefetch
+from omldm_tpu.runtime.serving import ServingConfig
+from omldm_tpu.runtime.supervisor import BurstInjector, parse_chaos_spec
+from omldm_tpu.runtime.vectorizer import MicroBatcher
+
+DIM = 8
+
+# a controller tuned small enough that a few hundred records traverse the
+# whole ladder (ELEVATED throttling -> CRITICAL shedding) and decay back
+OVR = "window=8,share=2,hotHigh=6,hotCritical=12,cool=8"
+SRV = {"maxBatch": 8, "maxDelayMs": 200.0}
+
+
+# --- config parsing / validation ---------------------------------------------
+
+
+class TestOverloadConfig:
+    def test_unset_is_none(self):
+        assert parse_overload_spec(None) is None
+        assert parse_overload_spec(False) is None
+        assert parse_overload_spec("") is None
+        assert overload_config(TrainingConfiguration()) is None
+
+    def test_defaults_and_spec_strings(self):
+        assert parse_overload_spec(True) == OverloadConfig()
+        assert parse_overload_spec("on") == OverloadConfig()
+        cfg = parse_overload_spec(OVR)
+        assert (cfg.window, cfg.share, cfg.hot_high, cfg.hot_critical,
+                cfg.cool) == (8, 2.0, 6.0, 12.0, 8)
+        cfg = parse_overload_spec(
+            {"tenantRate": 4, "widen": 2, "relax": "false", "shed": True,
+             "deferCap": 16, "queueHigh": 100, "queueCritical": 200}
+        )
+        assert (cfg.tenant_rate, cfg.widen, cfg.relax, cfg.shed,
+                cfg.defer_cap, cfg.queue_high, cfg.queue_critical) == (
+            4.0, 2.0, False, True, 16, 100, 200)
+
+    def test_job_default_and_per_pipeline_override(self):
+        tc = TrainingConfiguration()
+        assert overload_config(tc, "window=16").window == 16
+        tc_off = TrainingConfiguration(extra={"overload": False})
+        assert overload_config(tc_off, "window=16") is None
+        tc_own = TrainingConfiguration(extra={"overload": {"window": 4}})
+        assert overload_config(tc_own, "window=16").window == 4
+
+    @pytest.mark.parametrize("bad", [
+        {"window": 0}, {"share": 0}, {"widen": 0.5}, {"cool": 0},
+        {"hotHigh": 10, "hotCritical": 5}, {"deferCap": 0},
+        {"notAKnob": 1}, "window", 7,
+    ])
+    def test_invalid_specs_raise_and_gate(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            parse_overload_spec(bad)
+        tc = TrainingConfiguration(extra={"overload": bad})
+        assert validate_overload(tc) is not None
+
+    def test_bad_request_quarantined_not_fatal(self):
+        job = StreamJob(JobConfig(parallelism=1))
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": DIM}},
+            "trainingConfiguration": {"overload": {"window": 0}},
+        }))
+        assert 0 not in job.pipeline_manager.node_map
+        assert "rejected_request" in [
+            e["reason"] for e in job.dead_letter.entries
+        ]
+
+    def test_bad_job_default_fails_fast(self):
+        with pytest.raises(ValueError):
+            StreamJob(JobConfig(parallelism=1, overload="window=0"))
+
+
+# --- controller units (stub spoke) -------------------------------------------
+
+
+def _stub_controller(n_tenants=4, **knobs):
+    spec = dict(window=8, share=2.0, hot_high=6.0, hot_critical=12.0, cool=4)
+    spec.update(knobs)
+    cfg = OverloadConfig(**spec)
+    spoke = types.SimpleNamespace(serving_plane=None, serve_timer=None)
+    ctl = OverloadController(spoke, clock=lambda: 0.0)
+    nets = []
+    for nid in range(n_tenants):
+        net = types.SimpleNamespace(
+            request=types.SimpleNamespace(id=nid),
+            overload=cfg,
+            serving=ServingConfig(max_batch=8, max_delay_ms=100.0),
+        )
+        ctl.arm(net)
+        nets.append(net)
+    return ctl, nets
+
+
+class TestFairShareAdmission:
+    def test_uniform_traffic_never_flags(self):
+        ctl, nets = _stub_controller()
+        for _ in range(200):
+            for net in nets:
+                ctl.spend(net, 1)
+            ctl.tick(force=True)
+        assert ctl.level == OK
+        assert not any(ctl.is_over(n.request.id) for n in nets)
+        assert ctl._hot == 0.0
+
+    def test_flooded_tenant_goes_over_and_critical(self):
+        ctl, nets = _stub_controller()
+        for _ in range(40):
+            ctl.spend(nets[0], 8)
+            for net in nets[1:]:
+                ctl.spend(net, 1)
+            ctl.tick(force=True)
+        assert ctl.is_over(0)
+        assert not any(ctl.is_over(nid) for nid in (1, 2, 3))
+        assert ctl.level == CRITICAL
+        assert ctl.level_peak == CRITICAL
+        assert ctl.budget(0) < 0 < ctl.budget(1)
+
+    def test_flags_update_at_boundary_ticks_only(self):
+        # 24 rows: over the 2 x window = 16 limit, under one 32-row decay
+        # window (the count clock advances with the spends themselves)
+        ctl, nets = _stub_controller()
+        for _ in range(3):
+            ctl.spend(nets[0], 8)
+        # no tick yet: the verdict is still the last boundary's
+        assert not ctl.is_over(0)
+        ctl.tick(force=True)
+        assert ctl.is_over(0)
+
+    def test_tick_stride_defers_evaluation(self):
+        ctl, nets = _stub_controller()
+        for _ in range(3):
+            ctl.spend(nets[0], 8)
+        for _ in range(TICK_STRIDE - 1):
+            ctl.tick()
+        assert not ctl.is_over(0)
+        ctl.tick()  # the TICK_STRIDE-th boundary evaluates
+        assert ctl.is_over(0)
+
+    def test_tenant_rate_absolute_cap(self):
+        ctl, nets = _stub_controller(tenant_rate=0.25, hot_high=1e9,
+                                     hot_critical=1e9)
+        # everyone runs uniform WAY above the tenantRate x window = 2 row
+        # cap (the decayed steady-state count stays in [4, 12] at every
+        # halving phase) — fair share alone would never flag uniform
+        # traffic, so only the absolute cap can be flagging here
+        for _ in range(30):
+            for net in nets:
+                ctl.spend(net, 4)
+            ctl.tick(force=True)
+        assert all(ctl.is_over(n.request.id) for n in nets)
+
+    def test_retire_drops_accounting(self):
+        ctl, nets = _stub_controller()
+        for _ in range(3):
+            ctl.spend(nets[0], 8)
+        ctl.tick(force=True)
+        assert ctl.is_over(0)
+        ctl.retire(0)
+        assert not ctl.is_over(0)
+        assert 0 not in ctl._tenants and 0 not in ctl.deferred
+        assert ctl.n_live == 3
+
+
+class TestPressureLadder:
+    def test_hysteresis_cool_down(self):
+        ctl, nets = _stub_controller(cool=4)
+        for _ in range(40):
+            ctl.spend(nets[0], 8)
+            ctl.tick(force=True)
+        assert ctl.level == CRITICAL
+        # decay below every threshold: the level must hold for `cool`
+        # consecutive below-threshold ticks, then step down
+        steps = []
+        for _ in range(300):
+            ctl.idle_tick()
+            steps.append(ctl.level)
+            if ctl.level == OK:
+                break
+        assert ctl.level == OK
+        assert steps.count(CRITICAL) >= 1  # held before cooling
+        assert not ctl.is_over(0)
+
+    def test_degraded_serving_over_limit_tenant_only(self):
+        ctl, nets = _stub_controller()
+        for _ in range(40):
+            ctl.spend(nets[0], 8)
+            for net in nets[1:]:
+                ctl.spend(net, 1)
+            ctl.tick(force=True)
+        assert ctl.level == CRITICAL and ctl.is_over(0)
+        hot = ctl.degraded_serving(nets[0])
+        assert hot.max_batch == nets[0].serving.max_batch * 4
+        assert hot.max_delay_ms == nets[0].serving.max_delay_ms * 4
+        assert hot.staleness == "relaxed"
+        # healthy tenants keep the exact static config object
+        assert ctl.degraded_serving(nets[1]) is nets[1].serving
+
+    def test_degraded_serving_identity_at_ok(self):
+        ctl, nets = _stub_controller()
+        assert ctl.degraded_serving(nets[0]) is nets[0].serving
+
+    def test_external_signal_probe(self):
+        ctl, nets = _stub_controller()
+        fill = [0.0]
+        ctl.extra_signals["prefetch"] = lambda: (fill[0], 0.8, 0.95)
+        ctl.tick(force=True)
+        assert ctl.level == OK
+        fill[0] = 0.9
+        ctl.tick(force=True)
+        assert ctl.level == ELEVATED
+        fill[0] = 1.0
+        ctl.tick(force=True)
+        assert ctl.level == CRITICAL
+
+    def test_shed_log_and_counters(self):
+        ctl, _ = _stub_controller()
+        ctl.note_shed(0, 3)
+        ctl.note_shed(0, 2, latency_ms=7.5)
+        ctl.note_throttled(1, 4)
+        assert ctl.shed_log == [(0, 0, 3), (0, 0, 2)]
+        assert (ctl.total_shed, ctl.total_throttled) == (5, 4)
+        assert ctl.take_shed(0) == 5 and ctl.take_shed(0) == 0
+        assert ctl.take_throttled(1) == 4
+        assert ctl.shed_latency_p99(0) == 7.5
+        assert ctl.total_shed == 5  # cumulative survives the fold
+
+
+# --- job harness -------------------------------------------------------------
+
+
+def _job(overload, n_pipe=4, serving=SRV, chaos="", cohort="off",
+         codec=None, guard=False, protocol="Asynchronous", parallelism=1,
+         learner=None, test=True, job_overload="", **cfg_kw):
+    cfg = JobConfig(parallelism=parallelism, batch_size=16, test_set_size=16,
+                    cohort=cohort, cohort_min=2, test=test, chaos=chaos,
+                    overload=job_overload, **cfg_kw)
+    job = StreamJob(cfg)
+    learner = learner or {"name": "PA", "hyperParameters": {"C": 1.0}}
+    for pid in range(n_pipe):
+        tc = {"protocol": protocol, "syncEvery": 4}
+        if serving is not None:
+            tc["serving"] = serving
+        if overload is not None:
+            tc["overload"] = overload
+        if codec:
+            tc["comm"] = {"codec": codec}
+        if guard:
+            tc["guard"] = True
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": pid, "request": "Create",
+            "learner": {**learner, "dataStructure": {"nFeatures": DIM}},
+            "trainingConfiguration": tc,
+        }))
+    return job
+
+
+def _feed_records(job, records=320, seed=3):
+    """50/50 train/forecast per-record stream (the route burst clones
+    need: tenant-addressed records route at record granularity)."""
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(5).randn(DIM)
+    for i in range(records):
+        f = rng.randn(DIM).astype(np.float32)
+        if i % 2 == 0:
+            job.process_event(FORECASTING_STREAM, json.dumps(
+                {"numericalFeatures": f.tolist()}))
+        else:
+            job.process_event(TRAINING_STREAM, json.dumps(
+                {"numericalFeatures": f.tolist(),
+                 "target": float(f @ w > 0)}))
+    return job.terminate()
+
+
+def _digest(job, report):
+    ordered = {}
+    for p in job.predictions:
+        feats = tuple(np.asarray(p.data_instance.numerical_features).tolist())
+        ordered.setdefault(p.mlp_id, []).append((feats, p.value))
+    scores = {s.pipeline: s.score for s in report.statistics}
+    return ordered, scores
+
+
+# a burst spec flooding tenant 0 with 8x forecasts through the middle of
+# a 320-record (160-forecast) stream, leaving a ramp and a decay tail
+BURST = "seed=7,burst=8,burstFrom=20,burstLen=100,hotTenant=0"
+
+
+# --- unarmed identity (the composition matrix) -------------------------------
+
+
+MATRIX = [
+    dict(),
+    dict(cohort="on"),
+    dict(codec="int8"),
+    dict(guard=True),
+    dict(serving=None),
+    dict(cohort="on", codec="int8", guard=True),
+]
+
+
+class TestUnarmedIdentity:
+    @pytest.mark.parametrize("kw", MATRIX)
+    def test_no_controller_objects_when_unset(self, kw):
+        job = _job(None, **kw)
+        _feed_records(job, records=64)
+        for spoke in job.spokes:
+            assert spoke.overload is None
+            for net in spoke.nets.values():
+                assert net.overload is None and net._octl is None
+
+    @pytest.mark.parametrize("kw", MATRIX)
+    def test_armed_uniform_traffic_bit_identical(self, kw):
+        """Fair-share admission can never flag uniform fan-out traffic,
+        so an armed controller at level OK must not perturb a single
+        bit of the stream."""
+        off = _job(None, **kw)
+        d_off = _digest(off, _feed_records(off))
+        on = _job("on", **kw)
+        d_on = _digest(on, _feed_records(on))
+        assert d_off == d_on
+        stats = {}
+        for spoke in on.spokes:
+            assert spoke.overload is not None
+            assert spoke.overload.level_peak == OK
+            stats[id(spoke)] = spoke.overload.total_shed
+        assert all(v == 0 for v in stats.values())
+
+    def test_job_default_arms_every_pipeline(self):
+        job = _job(None, job_overload=OVR)
+        for spoke in job.spokes:
+            assert spoke.overload is not None
+            for net in spoke.nets.values():
+                assert net.overload is not None and net.overload.window == 8
+
+    def test_non_dict_metadata_never_routes_or_crashes(self):
+        """The validation boundary admits any JSON ``metadata`` value
+        (the reference parses and ignores it); a string/list there must
+        broadcast exactly like a metadata-free record — with the plane
+        armed AND unarmed — never raise."""
+        for overload in (None, "on"):
+            job = _job(overload, n_pipe=2)
+            for meta in ("clientA", ["x"], 7, {"other": 1}):
+                job.process_event(FORECASTING_STREAM, json.dumps(
+                    {"numericalFeatures": [0.0] * DIM, "metadata": meta}))
+            report = job.terminate()
+            # every record fanned out to both pipelines
+            for s in report.statistics:
+                assert s.forecasts_served == 4
+
+    def test_tenant_key_ignored_when_plane_and_burst_unarmed(self):
+        """Pre-PR, ``metadata`` was parsed and ignored: with neither the
+        overload plane nor the burst injector armed, a record carrying a
+        live ``tenant`` id must still BROADCAST (the bit-identity
+        invariant), not route to that pipeline alone."""
+        job = _job(None, n_pipe=3)
+        job.process_event(FORECASTING_STREAM, json.dumps(
+            {"numericalFeatures": [0.0] * DIM, "metadata": {"tenant": 1}}))
+        report = job.terminate()
+        for s in report.statistics:
+            assert s.forecasts_served == 1
+
+    def test_tenant_key_routes_when_armed(self):
+        job = _job(OVR, n_pipe=3)
+        job.process_event(FORECASTING_STREAM, json.dumps(
+            {"numericalFeatures": [0.0] * DIM, "metadata": {"tenant": 1}}))
+        report = job.terminate()
+        by_pipe = {s.pipeline: s.forecasts_served for s in report.statistics}
+        assert by_pipe == {0: 0, 1: 1, 2: 0}
+
+    def test_armed_parallel_2_identity(self):
+        off = _job(None, protocol="Synchronous", parallelism=2)
+        d_off = _digest(off, _feed_records(off))
+        on = _job("on", protocol="Synchronous", parallelism=2)
+        d_on = _digest(on, _feed_records(on))
+        assert d_off == d_on
+
+
+# --- burst shedding / throttling ---------------------------------------------
+
+
+class TestBurstShedding:
+    def _burst_job(self, **kw):
+        job = _job(OVR, chaos=BURST, **kw)
+        report = _feed_records(job)
+        return job, report
+
+    def test_hot_tenant_sheds_healthy_tenants_do_not(self):
+        job, report = self._burst_job()
+        by_pipe = {s.pipeline: s for s in report.statistics}
+        hot, healthy = by_pipe[0], [by_pipe[p] for p in (1, 2, 3)]
+        assert hot.forecasts_shed > 0
+        assert hot.pressure_level == CRITICAL
+        assert all(s.forecasts_shed == 0 for s in healthy)
+        # every healthy tenant served every one of the 160 stream
+        # forecasts — the flood was absorbed by the hot tenant alone
+        assert all(s.forecasts_served == 160 for s in healthy)
+        assert job.dead_letter.by_reason.get("shed_overload", 0) > 0
+
+    def test_shed_entries_carry_tenant_and_queue_depth(self):
+        job, _ = self._burst_job()
+        sheds = [e for e in job.dead_letter.entries
+                 if e["reason"] == "shed_overload"]
+        assert sheds
+        for e in sheds:
+            assert e["tenant"] == 0
+            assert "queueDepth" in e and e["queueDepth"] >= 0
+            assert e["stream"] == "forecastingData"
+
+    def test_training_deprioritized_but_never_lost(self):
+        job, report = self._burst_job()
+        by_pipe = {s.pipeline: s for s in report.statistics}
+        assert by_pipe[0].records_throttled > 0
+        # deferred rows drained (terminate trains them): nothing stranded
+        depths = job.queue_depths()
+        assert depths["throttled"] == 0
+        # the hot tenant still fitted its training rows — late, not lost
+        assert by_pipe[0].fitted > 0
+
+    def test_controller_recovers_to_ok(self):
+        job, _ = self._burst_job()
+        assert job.overload_level() == OK
+        for spoke in job.spokes:
+            assert spoke.overload.level == OK
+            assert spoke.overload.level_peak == CRITICAL
+
+    def test_defer_cap_overflow_quarantined_as_throttled(self):
+        job = _job(OVR + ",deferCap=4", chaos=BURST)
+        _feed_records(job)
+        assert job.dead_letter.by_reason.get("throttled", 0) > 0
+        throttled = [e for e in job.dead_letter.entries
+                     if e["reason"] == "throttled"]
+        assert all(e["tenant"] == 0 for e in throttled)
+
+    def test_shed_latency_gauge_on_queue_drains(self):
+        """Entering CRITICAL sheds the hot tenant's already-queued rows;
+        their enqueue->shed wait feeds the shedLatencyMs percentile."""
+        job, report = self._burst_job()
+        assert any(s.shed_latency_ms > 0 for s in report.statistics)
+
+    def test_shedding_disabled_serves_everything(self):
+        job, report = self._burst_job(serving=SRV)
+        total = sum(s.forecasts_shed for s in report.statistics)
+        assert total > 0
+        job2 = _job(OVR + ",shed=false", chaos=BURST)
+        report2 = _feed_records(job2)
+        assert sum(s.forecasts_shed for s in report2.statistics) == 0
+        assert job2.dead_letter.by_reason.get("shed_overload", 0) == 0
+
+
+class TestBurstDeterminism:
+    def _run(self, chaos=BURST):
+        job = _job(OVR, chaos=chaos)
+        report = _feed_records(job)
+        sched = []
+        for spoke in job.spokes:
+            sched.extend(spoke.overload.shed_log)
+        letters = [
+            (e["reason"], e.get("tenant"), e.get("queueDepth"), e["payload"])
+            for e in job.dead_letter.entries
+        ]
+        counters = {
+            s.pipeline: (s.forecasts_shed, s.records_throttled,
+                         s.pressure_level)
+            for s in report.statistics
+        }
+        return sched, letters, counters
+
+    def test_same_seed_same_shed_schedule(self):
+        a = self._run()
+        b = self._run()
+        assert a == b
+        assert a[0]  # non-vacuous: the schedule engaged
+
+    def test_different_window_different_schedule(self):
+        a = self._run()
+        b = self._run(chaos="seed=7,burst=8,burstFrom=40,burstLen=100,"
+                            "hotTenant=0")
+        assert a[0] != b[0]
+
+    def test_burst_injector_unit(self):
+        spec = parse_chaos_spec("burst=4,burstFrom=1,burstLen=2,hotTenant=9")
+        inj = BurstInjector.from_spec(spec)
+        from omldm_tpu.api.data import DataInstance, FORECASTING
+
+        train = DataInstance(numerical_features=[1.0], target=0.0)
+        fore = DataInstance(numerical_features=[1.0], operation=FORECASTING)
+        assert inj.clones(train) == ()       # training never amplifies
+        assert inj.clones(fore) == ()        # forecast 0: before the window
+        clones = inj.clones(fore)            # forecast 1: in the window
+        assert len(clones) == 3
+        assert all(c.metadata["tenant"] == 9 for c in clones)
+        assert inj.clones(fore) and not inj.clones(fore)  # window closes
+        assert inj.injected == 6
+
+    def test_burst_off_spec_is_none(self):
+        assert BurstInjector.from_spec(parse_chaos_spec("drop=0.1")) is None
+        assert BurstInjector.from_spec(None) is None
+
+
+# --- upstream backpressure ---------------------------------------------------
+
+
+class TestBackpressure:
+    def test_polling_events_pause_consumes_nothing(self):
+        class Rec:
+            def __init__(self, i):
+                self.topic = "trainingData"
+                self.value = b"{}"
+                self.partition = 0
+                self.offset = i
+
+        consumed = []
+
+        class Consumer:
+            def __init__(self):
+                self._it = iter([Rec(i) for i in range(3)])
+
+            def __next__(self):
+                r = next(self._it)
+                consumed.append(r.offset)
+                return r
+
+        paused = [True]
+        tracker = {}
+        events = polling_events(
+            Consumer(), tracker=tracker,
+            pause_when=lambda: paused[0], pause_sleep_s=0.0,
+        )
+        # paused: idle markers only, nothing consumed, offsets untracked
+        for _ in range(5):
+            assert next(events) is None
+        assert consumed == [] and tracker == {}
+        paused[0] = False
+        assert next(events) is not None
+        assert consumed == [0]
+        assert tracker == {("trainingData", 0): 1}
+
+    def test_job_overload_level_folds_spokes(self):
+        job = _job(OVR, parallelism=2)
+        assert job.overload_level() == OK
+        job.spokes[1].overload.level = CRITICAL
+        assert job.overload_level() == CRITICAL
+
+    def test_idle_ticks_clear_a_critical_pause(self):
+        """The backpressure dead-lock guard: nothing admits while the
+        source is paused, so idle ticks must decay the buckets and step
+        the level back down — or the pause would never lift."""
+        job = _job(OVR, chaos=BURST, n_pipe=4)
+        rng = np.random.RandomState(3)
+        hit_critical = False
+        for i in range(320):
+            f = rng.randn(DIM).astype(np.float32)
+            if i % 2 == 0:
+                job.process_event(FORECASTING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist()}))
+            else:
+                job.process_event(TRAINING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist(), "target": 1.0}))
+            if job.overload_level() >= CRITICAL:
+                hit_critical = True
+                break
+        assert hit_critical
+        for _ in range(400):
+            job.overload_idle_tick()
+            if job.overload_level() == OK:
+                break
+        assert job.overload_level() == OK
+        job.terminate()
+
+
+# --- bounded emission mirrors ------------------------------------------------
+
+
+class TestEmissionBufferCap:
+    def test_mirror_trimmed_with_sink_attached(self):
+        job = _job(None, n_pipe=2, emission_buffer_cap=50)
+        sunk = []
+        job.set_sinks(on_prediction=sunk.append)
+        _feed_records(job, records=300)
+        assert len(job.predictions) <= 50
+        assert job.predictions_trimmed > 0
+        # every prediction still reached the sink — only the mirror trims
+        assert len(sunk) == len(job.predictions) + job.predictions_trimmed
+
+    def test_unbounded_without_sink(self):
+        """Without a sink the list IS the job's output: never trimmed."""
+        job = _job(None, n_pipe=2, emission_buffer_cap=50)
+        _feed_records(job, records=300)
+        assert len(job.predictions) == 2 * 150
+        assert job.predictions_trimmed == 0
+
+    def test_cap_zero_disables_trimming(self):
+        job = _job(None, n_pipe=2, emission_buffer_cap=0)
+        job.set_sinks(on_prediction=lambda p: None)
+        _feed_records(job, records=300)
+        assert len(job.predictions) == 2 * 150
+
+
+# --- uniform queue-depth accessors -------------------------------------------
+
+
+class TestQueueDepths:
+    def test_micro_batcher_queued(self):
+        b = MicroBatcher(DIM, 8)
+        assert b.queued() == 0
+        b.add(np.zeros(DIM, np.float32), 1.0)
+        b.add(np.zeros(DIM, np.float32), 0.0)
+        assert b.queued() == 2 == len(b)
+        b.flush()
+        assert b.queued() == 0
+
+    def test_prefetcher_occupancy(self):
+        import threading
+
+        gate = threading.Semaphore(0)
+
+        def slow_source():
+            for i in range(4):
+                yield i
+                gate.acquire()
+
+        pf = prefetch(slow_source(), depth=2)
+        assert isinstance(pf, Prefetcher)
+        assert pf.depth == 2
+        assert next(pf) == 0
+        # one release per yield boundary (4 yields), so the source can
+        # run to exhaustion and deliver the sentinel
+        for _ in range(4):
+            gate.release()
+        out = list(pf)
+        assert out == [1, 2, 3]
+        assert pf.queued() == 0 and pf.occupancy() == 0.0
+
+    def test_spoke_and_job_depth_snapshots(self):
+        # 84 records = 42 training = 34 batched rows per net after the
+        # 20% holdout — NOT a multiple of the 16-row batch, so the
+        # batchers hold a ragged tail mid-stream
+        job = _job(OVR, chaos=BURST)
+        rng = np.random.RandomState(3)
+        for i in range(84):
+            f = rng.randn(DIM).astype(np.float32)
+            if i % 2 == 0:
+                job.process_event(FORECASTING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist()}))
+            else:
+                job.process_event(TRAINING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist(), "target": 1.0}))
+        keys = {"serving", "batcher", "throttled", "paused", "pre_create"}
+        for spoke in job.spokes:
+            assert set(spoke.queue_depths()) == keys
+        agg = job.queue_depths()
+        assert keys < set(agg)
+        assert "backlog" in agg and "pressure_level" in agg
+        # mid-stream the batchers hold staged rows
+        assert agg["batcher"] > 0
+        topo = job.tenant_topology()
+        assert topo["queues"]["batcher"] == agg["batcher"]
+        job.terminate()
+        after = job.queue_depths()
+        assert all(after[k] == 0 for k in keys)
+
+
+# --- statistics plumbing -----------------------------------------------------
+
+
+class TestStatsPlumbing:
+    def test_update_merge_and_to_dict(self):
+        a = Statistics(pipeline=1)
+        a.update_stats(forecasts_shed=5, records_throttled=3,
+                       pressure_level=1)
+        a.update_stats(forecasts_shed=2, pressure_level=2)
+        a.note_shed_latency(12.0)
+        a.note_shed_latency(7.0)
+        assert (a.forecasts_shed, a.records_throttled, a.pressure_level,
+                a.shed_latency_ms) == (7, 3, 2, 12.0)
+        b = Statistics(pipeline=1)
+        b.update_stats(forecasts_shed=1, records_throttled=9,
+                       pressure_level=1)
+        m = a.merge(b)
+        # counters sum; the pressure level and shed-latency p99 are
+        # gauges: max-combined
+        assert (m.forecasts_shed, m.records_throttled) == (8, 12)
+        assert (m.pressure_level, m.shed_latency_ms) == (2, 12.0)
+        d = m.to_dict()
+        assert (d["forecastsShed"], d["recordsThrottled"],
+                d["pressureLevel"], d["shedLatencyMs"]) == (8, 12, 2, 12.0)
+
+    def test_counters_zero_when_unarmed(self):
+        job = _job(None)
+        report = _feed_records(job, records=64)
+        for s in report.statistics:
+            assert (s.forecasts_shed, s.records_throttled,
+                    s.pressure_level, s.shed_latency_ms) == (0, 0, 0, 0.0)
