@@ -158,6 +158,13 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         "serve_latency_p50_ms": round(stats.serve_latency_p50_ms, 3),
         "serve_latency_p99_ms": round(stats.serve_latency_p99_ms, 3),
         "serve_latency_p999_ms": round(stats.serve_latency_p999_ms, 3),
+        # model-lifecycle counters (runtime/lifecycle.py): zero with the
+        # plane unarmed (the default here); shadow/canary activity and
+        # the live version gauge engage under --lifecycle-smoke
+        "shadow_scored": stats.shadow_scored,
+        "canary_promotions": stats.canary_promotions,
+        "canary_rollbacks": stats.canary_rollbacks,
+        "active_version": stats.active_version,
         # overload-control counters (runtime/overload.py): zero with the
         # plane unarmed; under pressure the shed/throttle/pressure gauges
         # engage (--overload-smoke gates them)
@@ -604,6 +611,103 @@ def run_overload_one(n_pipe, x, y, burst, records=None, batch=256,
     }
 
 
+# the lifecycle-smoke operating point (ISSUE 11): one lifecycle-armed
+# pipeline on a 50/50 per-record train/forecast stream; the canary ramps
+# 0 -> 50% (step 0.125 every 64 canary-era forecasts), auto-promotion
+# needs 128 canary serves at the full ramp + 2 healthy shadow evals
+LIFECYCLE_SPEC = {
+    "rampFrom": 0.0, "rampTo": 0.5, "rampEvery": 64, "rampStep": 0.125,
+    "promoteAfter": 128, "shadowEvery": 8, "minShadowEvals": 2,
+    "scoreEnvelope": 0.05, "seed": 7,
+}
+
+
+def run_lifecycle_one(x, y, mode, lifecycle=None, poison_at=1024):
+    """One lifecycle job on a 50/50 per-record stream. ``mode``:
+
+    - ``"off"``: lifecycle unarmed — the pre-plane reference leg;
+    - ``"healthy"``: Shadow + Promote a healthy candidate (same learner,
+      softer C) and let the ramp auto-promote it;
+    - ``"hold"``: same canary but promoteAfter beyond the stream — the
+      ramp serves the whole run, pinning baseline bitwise identity;
+    - ``"poison"``: Shadow + Promote, then seed the candidate's params
+      with an exploding vector at event ``poison_at`` — the candidate's
+      guard must trip and auto-roll the canary back.
+
+    Returns emitted predictions (value, version) in stream order plus the
+    registry view and folded statistics."""
+    import numpy as np
+
+    from omldm_tpu.api.data import DataInstance, FORECASTING
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import (
+        FORECASTING_STREAM,
+        REQUEST_STREAM,
+        TRAINING_STREAM,
+    )
+
+    records = x.shape[0]
+    spec = dict(lifecycle or LIFECYCLE_SPEC)
+    if mode == "hold":
+        spec["promoteAfter"] = 10 * records
+    job = StreamJob(JobConfig(
+        parallelism=1, batch_size=64, test_set_size=64, test=True,
+    ))
+    tc = {"protocol": "Asynchronous", "syncEvery": 4}
+    if mode != "off":
+        tc["lifecycle"] = spec
+    job.process_event(REQUEST_STREAM, json.dumps({
+        "id": 0, "request": "Create",
+        "learner": {
+            "name": "PA", "hyperParameters": {"C": 1.0},
+            "dataStructure": {"nFeatures": int(x.shape[1])},
+        },
+        "trainingConfiguration": tc,
+    }))
+    if mode != "off":
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Shadow",
+            "learner": {
+                "name": "PA", "hyperParameters": {"C": 0.5},
+                "dataStructure": {"nFeatures": int(x.shape[1])},
+            },
+        }))
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Promote"}
+        ))
+    net = job.spokes[0].nets[0]
+    for i in range(records):
+        if mode == "poison" and i == poison_at:
+            entry = net.lifecycle.candidate_entry
+            if entry is not None and entry.pipeline is not None:
+                flat, _ = entry.pipeline.get_flat_params()
+                entry.pipeline.set_flat_params(
+                    np.full_like(flat, 1.0e9)
+                )
+        if i % 2 == 0:
+            job.process_event(FORECASTING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), operation=FORECASTING))
+        else:
+            job.process_event(TRAINING_STREAM, DataInstance(
+                numerical_features=x[i].tolist(), target=float(y[i])))
+    lc = net.lifecycle.describe() if net.lifecycle is not None else None
+    preds = [(p.value, p.version) for p in job.predictions]
+    report = job.terminate()
+    [stats] = report.statistics
+    return {
+        "mode": mode,
+        "predictions": preds,
+        "lifecycle": lc,
+        "score": round(stats.score, 4),
+        "shadow_scored": stats.shadow_scored,
+        "canary_promotions": stats.canary_promotions,
+        "canary_rollbacks": stats.canary_rollbacks,
+        "active_version": stats.active_version,
+        "forecasts_served": stats.forecasts_served,
+    }
+
+
 # codecs swept by --codec sweep, and the host protocols the codec section
 # compares (the model-shipping protocols; GM/FGM traffic is mostly votes)
 CODEC_SWEEP = ("none", "fp16", "int8", "topk")
@@ -815,6 +919,15 @@ def main() -> None:
              "maxDelayMs budget, healthy forecast throughput drops more "
              "than 10%% vs the no-burst baseline, or the controller "
              "fails to return to OK after the burst",
+    )
+    ap.add_argument(
+        "--lifecycle-smoke", action="store_true",
+        help="CI gate: model-lifecycle plane end to end — a healthy "
+             "Shadow candidate must ramp 0%%->50%% and auto-PROMOTE, a "
+             "seeded-poison candidate must auto-ROLL-BACK via its guard "
+             "with zero forecast loss, and with a canary armed the "
+             "baseline-version predictions must stay BITWISE equal to a "
+             "no-lifecycle run; NONZERO EXIT otherwise",
     )
     ap.add_argument(
         "--chaos-smoke", action="store_true",
@@ -1334,6 +1447,120 @@ def main() -> None:
             "healthy_throughput_ratio": round(ratio, 3),
             "no_burst": base,
             "burst": burst,
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
+
+    if args.lifecycle_smoke:
+        # CI gate (ISSUE 11 acceptance): one lifecycle-armed pipeline on
+        # a 50/50 per-record stream, four legs on the SAME deterministic
+        # stream:
+        #   (a) HEALTHY — a Shadow candidate ramps 0 -> 50% and
+        #       auto-promotes (canaryPromotions engages, the registry's
+        #       active version advances, shadow scoring ran);
+        #   (b) HOLD — the canary serves the whole stream without
+        #       promoting: every baseline-version (untagged) prediction
+        #       must be BITWISE equal to the no-lifecycle leg's value at
+        #       the same stream position — candidate training and canary
+        #       routing never perturb the active model;
+        #   (c) POISON — the candidate's params are seeded with an
+        #       exploding vector mid-canary: its guard must trip and
+        #       auto-roll the canary back (canaryRollbacks engages, the
+        #       active version stays 0) with ZERO forecast loss (every
+        #       forecast answered) and the same baseline bitwise pin.
+        records = min(args.records, 6_144)
+        x, y = _mt_stream(records)
+        off = run_lifecycle_one(x, y, "off")
+        healthy = run_lifecycle_one(x, y, "healthy")
+        hold = run_lifecycle_one(x, y, "hold")
+        poison = run_lifecycle_one(x, y, "poison")
+        failures = []
+        if healthy["canary_promotions"] < 1:
+            failures.append(
+                "the healthy candidate never promoted "
+                f"(canary_promotions {healthy['canary_promotions']})"
+            )
+        if healthy["canary_rollbacks"]:
+            failures.append(
+                f"{healthy['canary_rollbacks']} rollbacks on the healthy "
+                "candidate"
+            )
+        if healthy["active_version"] != 1:
+            failures.append(
+                "the registry's active version did not advance after the "
+                f"healthy promotion (gauge {healthy['active_version']})"
+            )
+        if healthy["shadow_scored"] < 2:
+            failures.append(
+                "shadow scoring never ran on the healthy candidate "
+                f"(shadow_scored {healthy['shadow_scored']})"
+            )
+        if poison["canary_rollbacks"] < 1:
+            failures.append(
+                "the seeded-poison candidate never rolled back "
+                f"(canary_rollbacks {poison['canary_rollbacks']})"
+            )
+        if poison["canary_promotions"]:
+            failures.append("the poisoned candidate PROMOTED")
+        if poison["lifecycle"]["activeVersion"] != 0:
+            failures.append(
+                "the poison leg's active version moved off the baseline "
+                f"({poison['lifecycle']['activeVersion']})"
+            )
+        for leg in (healthy, hold, poison):
+            if len(leg["predictions"]) != len(off["predictions"]):
+                failures.append(
+                    f"{leg['mode']} leg answered "
+                    f"{len(leg['predictions'])} forecasts vs "
+                    f"{len(off['predictions'])} without the plane — "
+                    "forecast loss"
+                )
+        for leg in (hold, poison):
+            mismatches = sum(
+                1
+                for (v, ver), (v0, _) in zip(
+                    leg["predictions"], off["predictions"]
+                )
+                if ver is None and v != v0
+            )
+            if mismatches:
+                failures.append(
+                    f"{mismatches} baseline-version predictions of the "
+                    f"{leg['mode']} leg diverged from the no-lifecycle "
+                    "run — the bitwise pin"
+                )
+        canary_served = sum(
+            1 for _v, ver in hold["predictions"] if ver is not None
+        )
+        if canary_served == 0:
+            failures.append(
+                "the hold leg's canary never served — the bitwise pin "
+                "is vacuous"
+            )
+        summary = {
+            k: {
+                "score": leg["score"],
+                "shadow_scored": leg["shadow_scored"],
+                "canary_promotions": leg["canary_promotions"],
+                "canary_rollbacks": leg["canary_rollbacks"],
+                "active_version": leg["active_version"],
+                "forecasts": len(leg["predictions"]),
+                "canary_tagged": sum(
+                    1 for _v, ver in leg["predictions"] if ver is not None
+                ),
+            }
+            for k, leg in (
+                ("off", off), ("healthy", healthy),
+                ("hold", hold), ("poison", poison),
+            )
+        }
+        print(json.dumps({
+            "config": "protocol_comparison_lifecycle_smoke",
+            "records": records,
+            "lifecycle_spec": LIFECYCLE_SPEC,
+            **summary,
             "failures": failures,
         }))
         if failures:

@@ -200,13 +200,23 @@ class Prediction:
     mlp_id: int
     data_instance: Optional[DataInstance]
     value: Any
+    # model-lifecycle version tag (runtime/lifecycle.py): set ONLY on
+    # canary-routed predictions served by a candidate version, so
+    # operators (and the bitwise-identity gates) can separate candidate
+    # output from the active version's. None — the default, and always
+    # for lifecycle-unarmed pipelines — keeps the wire payload
+    # byte-identical to the pre-plane format
+    version: Optional[int] = None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "mlpId": self.mlp_id,
             "dataInstance": self.data_instance.to_dict() if self.data_instance else None,
             "value": self.value,
         }
+        if self.version is not None:
+            out["version"] = self.version
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

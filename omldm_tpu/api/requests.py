@@ -21,6 +21,24 @@ class RequestType(str, enum.Enum):
     UPDATE = "Update"
     QUERY = "Query"
     DELETE = "Delete"
+    # model-lifecycle verbs (runtime/lifecycle.py; no reference
+    # counterpart — the reference's only rollout primitive is the
+    # destructive Update, PipelineMap.scala:43-47): Shadow registers a
+    # candidate model configuration that trains + scores on the live
+    # stream without serving; Promote starts (or completes) the canary
+    # traffic ramp; Rollback demotes the candidate — or, after a
+    # promotion, reactivates the retained previous version
+    SHADOW = "Shadow"
+    PROMOTE = "Promote"
+    ROLLBACK = "Rollback"
+
+
+# the lifecycle verb subset (validated and routed together)
+LIFECYCLE_REQUESTS = (
+    RequestType.SHADOW,
+    RequestType.PROMOTE,
+    RequestType.ROLLBACK,
+)
 
 
 @dataclasses.dataclass

@@ -31,6 +31,11 @@ class QueryResponse:
     loss: Optional[float] = None
     cumulative_loss: Optional[float] = None
     score: Optional[float] = None
+    # model-lifecycle observability (runtime/lifecycle.py): the worker's
+    # registry view — active version, canary percentage, per-version
+    # shadow scores — riding bucket-0 fragments of lifecycle-armed
+    # pipelines; None (the default) keeps the pre-plane wire shape
+    lifecycle: Optional[Mapping[str, Any]] = None
     # internal routing metadata (NOT part of the wire format): which worker
     # emitted this fragment — lets the merger re-assemble parameter buckets
     # from a single replica's fragment set even when replicas differ
@@ -51,10 +56,11 @@ class QueryResponse:
             loss=obj.get("loss"),
             cumulative_loss=obj.get("cumulativeLoss"),
             score=obj.get("score"),
+            lifecycle=obj.get("lifecycle"),
         )
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "responseId": self.response_id,
             "id": self.bucket,
             "numBuckets": self.num_buckets,
@@ -67,6 +73,9 @@ class QueryResponse:
             "cumulativeLoss": self.cumulative_loss,
             "score": self.score,
         }
+        if self.lifecycle is not None:
+            out["lifecycle"] = dict(self.lifecycle)
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -98,6 +98,17 @@ class Statistics:
     records_throttled: int = 0
     pressure_level: int = 0
     shed_latency_ms: float = 0.0
+    # model-lifecycle counters (runtime/lifecycle.py; zero with the plane
+    # unarmed, the default): holdout shadow evaluations performed on a
+    # candidate version, canary ramps that auto-promoted, rollbacks
+    # (guard trips, score-envelope regressions, operator Rollbacks), and
+    # the live model-version id — a GAUGE (0 = the Create-time model;
+    # last-write per fold so a rollback moves it back down, max-combined
+    # only across same-probe worker replicas in merge)
+    shadow_scored: int = 0
+    canary_promotions: int = 0
+    canary_rollbacks: int = 0
+    active_version: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -123,10 +134,18 @@ class Statistics:
         forecasts_shed: int = 0,
         records_throttled: int = 0,
         pressure_level: int = 0,
+        shadow_scored: int = 0,
+        canary_promotions: int = 0,
+        canary_rollbacks: int = 0,
+        active_version: Optional[int] = None,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127).
         ``cohort_shards`` and ``pressure_level`` are gauges: max-combined,
-        not summed."""
+        not summed. ``active_version`` is a LAST-WRITE gauge: each fold
+        carries the registry's CURRENT live version (None = this fold says
+        nothing about it), so an operator rollback to version 0 really
+        moves the reported value back down — a max would pin the
+        historical peak forever."""
         self.models_shipped += models_shipped
         self.bytes_shipped += bytes_shipped
         self.num_of_blocks += num_of_blocks
@@ -144,6 +163,11 @@ class Statistics:
         self.forecasts_shed += forecasts_shed
         self.records_throttled += records_throttled
         self.pressure_level = max(self.pressure_level, pressure_level)
+        self.shadow_scored += shadow_scored
+        self.canary_promotions += canary_promotions
+        self.canary_rollbacks += canary_rollbacks
+        if active_version is not None:
+            self.active_version = active_version
 
     def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
         """Fold one contributor's serving-latency percentile window in
@@ -216,6 +240,11 @@ class Statistics:
             + other.records_throttled,
             pressure_level=max(self.pressure_level, other.pressure_level),
             shed_latency_ms=max(self.shed_latency_ms, other.shed_latency_ms),
+            shadow_scored=self.shadow_scored + other.shadow_scored,
+            canary_promotions=self.canary_promotions
+            + other.canary_promotions,
+            canary_rollbacks=self.canary_rollbacks + other.canary_rollbacks,
+            active_version=max(self.active_version, other.active_version),
             serve_latency_p50_ms=max(
                 self.serve_latency_p50_ms, other.serve_latency_p50_ms
             ),
@@ -259,6 +288,10 @@ class Statistics:
             "recordsThrottled": self.records_throttled,
             "pressureLevel": self.pressure_level,
             "shedLatencyMs": self.shed_latency_ms,
+            "shadowScored": self.shadow_scored,
+            "canaryPromotions": self.canary_promotions,
+            "canaryRollbacks": self.canary_rollbacks,
+            "activeVersion": self.active_version,
             "serveLatencyP50Ms": self.serve_latency_p50_ms,
             "serveLatencyP99Ms": self.serve_latency_p99_ms,
             "serveLatencyP999Ms": self.serve_latency_p999_ms,

@@ -136,6 +136,17 @@ class CheckpointManager:
                     "pending": self._batcher_contents(net.batcher),
                     "node": _node_state(net.node),
                 }
+                # the guard's LKG rollback ring survives a restart (a
+                # reseed at the restored params could make a corruption
+                # that slipped into the snapshot its own rollback target)
+                if pipe.guard is not None:
+                    nets[net_id]["guard"] = pipe.guard.snapshot()
+                # the model-lifecycle registry (versions, candidate
+                # pipeline state, canary clocks) — a supervised restart
+                # resumes MID-CANARY instead of silently reverting to a
+                # single unversioned model
+                if net.lifecycle is not None:
+                    nets[net_id]["lifecycle"] = net.lifecycle.snapshot()
             spokes.append(nets)
         hub_nodes = {}
         for (net_id, hub_id), hub in job.hub_manager.hubs.items():
@@ -467,6 +478,13 @@ class CheckpointManager:
             pipe.state["cum_loss"] = jnp.asarray(
                 total_cum_loss / len(new_spokes), jnp.float32
             )
+            # the guard's LKG ring restarts at the MERGED model (the saved
+            # per-replica rings describe states no restored worker holds —
+            # a rollback onto one would undo the merge); the lifecycle
+            # registry likewise restarts clean: candidate/canary clocks
+            # are per-replica and only well-defined 1:1
+            if pipe.guard is not None:
+                pipe.guard.reseed(pipe)
             net.holdout_count = max(sv["holdout_count"] for sv in saved)
 
         # ...and redistribute holdout points + pending records round-robin;
@@ -484,7 +502,18 @@ class CheckpointManager:
 
     @classmethod
     def _load_net_state(cls, net, sv: dict) -> None:
-        _pipeline_load(net.pipeline, sv)
+        # lifecycle registry first: when the snapshot's ACTIVE version is
+        # a promoted candidate, restore() rebuilds that pipeline from its
+        # spec, loads this snapshot's pipeline fields into it, and
+        # installs it — the default load below would otherwise push
+        # promoted-spec params into the Create-spec pipeline
+        swapped = False
+        if net.lifecycle is not None and sv.get("lifecycle") is not None:
+            swapped = net.lifecycle.restore(net, sv["lifecycle"], sv)
+        if not swapped:
+            _pipeline_load(net.pipeline, sv)
+        if net.pipeline.guard is not None and sv.get("guard") is not None:
+            net.pipeline.guard.restore(sv["guard"])
         net.holdout_count = sv["holdout_count"]
         for p in sv["test_set"]:
             net.test_set.append(p)

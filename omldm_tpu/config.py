@@ -131,6 +131,23 @@ class JobConfig:
     # pipeline out of this default).
     serving: str = ""
 
+    # --- model lifecycle (runtime/lifecycle.py; no reference counterpart:
+    # the reference's only rollout primitive is the destructive Update
+    # that tears the live model down, PipelineMap.scala:43-47) ---
+    # Job-wide DEFAULT lifecycle spec applied to pipelines whose
+    # trainingConfiguration carries no "lifecycle" table of their own,
+    # e.g. "rampTo=0.5,rampEvery=64,seed=7" or "on". Empty (default):
+    # nothing is armed — zero lifecycle objects exist and every route is
+    # the exact pre-plane code path. Armed, each pipeline gains a model-
+    # version registry: Shadow requests register candidate configurations
+    # that train + holdout-score on the live stream without serving,
+    # Promote starts a deterministic hash-routed canary traffic ramp, and
+    # the guard fence (candidate normLimit/non-finite trip) or a shadow-
+    # score regression past scoreEnvelope auto-rolls the candidate back.
+    # Per-pipeline trainingConfiguration.lifecycle always wins (an
+    # explicit false opts a pipeline out).
+    lifecycle: str = ""
+
     # --- overload control (runtime/overload.py; the reference delegates
     # overload entirely to Flink's credit-based network backpressure,
     # SURVEY §5 — the job itself has no admission control) ---

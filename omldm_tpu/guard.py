@@ -253,6 +253,31 @@ class ModelGuard:
         self._fits_since_snapshot = 0
         self.maybe_snapshot(pipeline)
 
+    def snapshot(self) -> dict:
+        """Host-side snapshot of the LKG ring + cadence/trip counters for
+        checkpointing — a supervised restart must keep its rollback
+        targets instead of reseeding the ring at the restored params (a
+        corruption that slipped into the snapshot would then be its own
+        rollback target)."""
+        return {
+            "ring": [r.copy() for r in self._ring],
+            "fits_since": self._fits_since_snapshot,
+            "trips": self.trips,
+            "last_reason": self.last_reason,
+        }
+
+    def restore(self, sv: dict) -> None:
+        """Reload a :meth:`snapshot` (the ring keeps its configured
+        ``lkgDepth`` bound; pending in-flight health evidence does not
+        survive a restart — the snapshot was taken between events)."""
+        self._ring.clear()
+        for row in sv.get("ring", ()):
+            self._ring.append(np.asarray(row, np.float32).copy())
+        self._fits_since_snapshot = int(sv.get("fits_since", 0))
+        self.trips = int(sv.get("trips", 0))
+        self.last_reason = sv.get("last_reason")
+        self._pending = None
+
     def rollback(self, pipeline) -> bool:
         """Restore the most recent LKG snapshot into the pipeline (and
         sanitize a non-finite cumulative loss so statistics stay

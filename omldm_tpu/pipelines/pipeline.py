@@ -214,6 +214,12 @@ class MLPipeline:
         # pipeline dispatches (or triggers, for shared cohort launches) —
         # feeds the Statistics `programLaunches` counter
         self.on_launch: Optional[Callable[[], None]] = None
+        # model-lifecycle version attachment (runtime/lifecycle.py): 0 is
+        # the Create-time model; the version registry stamps candidates
+        # with their registry row id when it arms them, and the id follows
+        # the pipeline through promotion/rollback swaps. Purely a tag —
+        # nothing in the pipeline math reads it.
+        self.version = 0
         # feature dim after each preprocessor
         d = dim
         self._dims = [d]

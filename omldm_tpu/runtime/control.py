@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.api.requests import LIFECYCLE_REQUESTS, Request, RequestType
 from omldm_tpu.learners.registry import SINGLE_LEARNER_ONLY, is_valid_learner
 from omldm_tpu.preprocessors.registry import is_valid_preprocessor
 
@@ -47,7 +47,12 @@ class PipelineManager:
             err = self._validate_serving(request)
             if err:
                 return err
-            return self._validate_overload(request)
+            err = self._validate_overload(request)
+            if err:
+                return err
+            return self._validate_lifecycle(request)
+        if request.request in LIFECYCLE_REQUESTS:
+            return self._validate_lifecycle_verb(request)
         if request.request in (RequestType.UPDATE, RequestType.QUERY, RequestType.DELETE):
             if request.id not in self.node_map:
                 return f"pipeline {request.id} does not exist"
@@ -63,7 +68,10 @@ class PipelineManager:
                 err = self._validate_serving(request)
                 if err:
                     return err
-                return self._validate_overload(request)
+                err = self._validate_overload(request)
+                if err:
+                    return err
+                return self._validate_lifecycle(request)
             return None
         return f"unknown request type {request.request}"
 
@@ -121,6 +129,39 @@ class PipelineManager:
         from omldm_tpu.runtime.serving import validate_serving
 
         return validate_serving(request.training_configuration)
+
+    @staticmethod
+    def _validate_lifecycle(request: Request) -> Optional[str]:
+        """Model-lifecycle config must be deployable for the same reason
+        as the serving/overload gates: an unknown knob, an inverted ramp,
+        or an unservable combination (sparse learner, SPMD engine) would
+        raise at SpokeNet construction and kill the job instead of
+        dropping the one bad request."""
+        from omldm_tpu.runtime.lifecycle import validate_lifecycle
+
+        return validate_lifecycle(request)
+
+    def _validate_lifecycle_verb(self, request: Request) -> Optional[str]:
+        """Shadow / Promote / Rollback target a LIVE pipeline; a Shadow
+        additionally names the candidate configuration — a full learner
+        spec (the "new model configuration"), dense only (the candidate
+        predict/flat-param paths are dense). Whether the target pipeline
+        actually has the lifecycle plane armed is the job's call (it
+        holds the job-wide default spec); here the request must merely be
+        structurally deployable."""
+        if request.id not in self.node_map:
+            return f"pipeline {request.id} does not exist"
+        if request.request == RequestType.SHADOW:
+            if request.learner is None:
+                return "Shadow request without a candidate learner"
+            if not is_valid_learner(request.learner.name):
+                return f"unknown learner {request.learner.name!r}"
+            if (request.learner.data_structure or {}).get("sparse"):
+                return "lifecycle candidates must be dense learners"
+            for p in request.preprocessors:
+                if not is_valid_preprocessor(p.name):
+                    return f"unknown preprocessor {p.name!r}"
+        return None
 
     @staticmethod
     def _validate_overload(request: Request) -> Optional[str]:

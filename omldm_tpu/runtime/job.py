@@ -21,7 +21,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from omldm_tpu.api.data import FORECASTING, TRAINING, DataInstance, Prediction
-from omldm_tpu.api.requests import Request, RequestType
+from omldm_tpu.api.requests import LIFECYCLE_REQUESTS, Request, RequestType
 from omldm_tpu.api.responses import TERMINATION_RESPONSE_ID, QueryResponse
 from omldm_tpu.api.stats import JobStatistics
 from omldm_tpu.config import JobConfig
@@ -75,6 +75,11 @@ class StreamJob:
         from omldm_tpu.runtime.overload import parse_overload_spec
 
         parse_overload_spec(getattr(self.config, "overload", ""))
+        # ... and for a malformed job-wide lifecycle default
+        # (runtime/lifecycle.py)
+        from omldm_tpu.runtime.lifecycle import parse_lifecycle_spec
+
+        parse_lifecycle_spec(getattr(self.config, "lifecycle", ""))
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         # dead-letter quarantine: malformed / validation-rejected records
         # and requests land here with reason codes instead of vanishing
@@ -378,6 +383,48 @@ class StreamJob:
             self._pending_creates = [
                 r for r in self._pending_creates if r.id != request.id
             ]
+        elif request.request in LIFECYCLE_REQUESTS:
+            # model-lifecycle verbs (Shadow / Promote / Rollback): the
+            # structural validation already passed the gate above; the
+            # ARMING check needs the job-wide default spec, so it lives
+            # here — an unarmed (or SPMD-deployed) target quarantines the
+            # request instead of silently ignoring it
+            from omldm_tpu.runtime.lifecycle import lifecycle_config
+
+            if request.id in self.spmd_bridges:
+                self.dead_letter.quarantine(
+                    REQUEST_STREAM, request.to_json(), "rejected_request",
+                    detail="lifecycle verbs are host-plane only",
+                )
+                return
+            if request.id not in self._dims:
+                # admitted but not deployed yet (awaiting dim inference):
+                # no worker hosts it — same drop rule as an early Query
+                return
+            live = self.pipeline_manager.node_map.get(request.id)
+            armed = live is not None and lifecycle_config(
+                live.training_configuration,
+                getattr(self.config, "lifecycle", ""),
+            ) is not None
+            if armed and live.learner is not None and (
+                (live.learner.data_structure or {}).get("sparse")
+            ):
+                # a job-wide lifecycle default does not arm sparse nets
+                # (SpokeNet leaves lifecycle None — the candidate
+                # predict/flat paths are dense), so a verb aimed at one
+                # must quarantine here, not vanish spoke-side
+                armed = False
+            if not armed:
+                self.dead_letter.quarantine(
+                    REQUEST_STREAM, request.to_json(), "rejected_request",
+                    detail=(
+                        f"lifecycle plane not armed for pipeline "
+                        f"{request.id}"
+                    ),
+                )
+                return
+            for spoke in self.spokes:
+                spoke.handle_request(request, self._dims.get(request.id, 0))
         elif request.request == RequestType.QUERY:
             if request.id not in self._dims:
                 # pipeline admitted but not deployed yet (awaiting dim
@@ -731,7 +778,21 @@ class StreamJob:
             # so BENCH rounds see WHERE work is waiting, not just where
             # tenants run
             "queues": self.queue_depths(),
+            # model-lifecycle registries (runtime/lifecycle.py): each
+            # armed pipeline's active version, canary percentage and
+            # per-version shadow scores — the worker-0 replica's view
+            # (the canary clocks are per-spoke; worker 0 is the
+            # representative, like query routing for single-learner
+            # models) so operators can watch a rollout without scraping
+            # logs. Empty when the plane is unarmed everywhere.
+            "lifecycle": {},
         }
+        for spoke in self.spokes:
+            for net_id, net in spoke.nets.items():
+                if net.lifecycle is not None:
+                    topo["lifecycle"].setdefault(
+                        net_id, net.lifecycle.describe()
+                    )
         for spoke in self.spokes:
             engine = spoke.cohorts
             if engine is None:

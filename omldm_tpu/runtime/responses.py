@@ -61,6 +61,11 @@ class ResponseMerger:
                 out.preprocessors = f.preprocessors
             if f.protocol is not None:
                 out.protocol = f.protocol
+            if f.lifecycle is not None:
+                # registry views are per-worker replicas of the same
+                # count-clocked state machine; keep the last non-null one
+                # (the learner/protocol merge rule) rather than averaging
+                out.lifecycle = dict(f.lifecycle)
             out.data_fitted += f.data_fitted
         n = max(len(heads), 1)
         out.loss = sum((f.loss or 0.0) for f in heads) / n

@@ -43,6 +43,14 @@ from omldm_tpu.runtime.messages import (
     channel_window_size,
     reliability_armed,
 )
+from omldm_tpu.runtime.lifecycle import (
+    CANARY,
+    REASON_OPERATOR,
+    SHADOW,
+    LifecycleState,
+    build_candidate,
+    lifecycle_config,
+)
 from omldm_tpu.runtime.overload import (
     CRITICAL,
     ELEVATED,
@@ -68,6 +76,26 @@ from omldm_tpu.utils.tracing import StepTimer
 # width of the immediate-serving predict batch (forecasting records are padded
 # into this fixed shape so the predict jit never recompiles)
 PREDICT_BATCH = 16
+
+
+def create_pipeline(request: Request, dim: int) -> MLPipeline:
+    """THE Create-request pipeline recipe — rng derivation, per-record
+    mode, guard arming. SpokeNet construction and the lifecycle plane's
+    retained-version rebuild (runtime/lifecycle._version_zero_pipeline)
+    both go through here so the two can never drift: a restored version-0
+    model must load into exactly the pipeline Create would have built."""
+    tc = request.training_configuration
+    return MLPipeline(
+        request.learner,
+        request.preprocessors,
+        dim=dim,
+        rng=jax.random.PRNGKey(request.id),
+        per_record=tc.per_record,
+        # model-integrity guard (trainingConfiguration.guard): fused
+        # in-program health checks + the LKG rollback ring; None
+        # (default) keeps the exact pre-guard programs
+        guard=guard_config(tc),
+    )
 
 
 class _PauseBuffer:
@@ -169,17 +197,7 @@ class SpokeNet:
             hash_dims = int(tc.extra.get("hashDims", 0))
             self.vectorizer = Vectorizer(dim, hash_dims)
             self.batcher = MicroBatcher(dim, batch)
-        pipeline = MLPipeline(
-            request.learner,
-            request.preprocessors,
-            dim=dim,
-            rng=jax.random.PRNGKey(request.id),
-            per_record=tc.per_record,
-            # model-integrity guard (trainingConfiguration.guard): fused
-            # in-program health checks + the LKG rollback ring; None
-            # (default) keeps the exact pre-guard programs
-            guard=guard_config(tc),
-        )
+        pipeline = create_pipeline(request, dim)
         self.node = make_worker_node(
             self.protocol, pipeline, worker_id, n_workers, tc, send
         )
@@ -210,6 +228,21 @@ class SpokeNet:
         # reference is attached by the hosting Spoke at create time.
         self.overload = overload_config(tc, getattr(config, "overload", ""))
         self._octl: Optional[OverloadController] = None
+        # model-lifecycle plane (trainingConfiguration.lifecycle /
+        # JobConfig.lifecycle): when armed, this net owns a per-pipeline
+        # model-version registry — Shadow candidates twin-train on the
+        # same flushed batches, canary routing splits forecasts at the
+        # serve-admission boundary, and the candidate's guard fences the
+        # rollback (runtime/lifecycle.py). None (default, and always for
+        # sparse nets — the candidate predict/flat paths are dense) keeps
+        # the exact pre-plane routes.
+        lc_cfg = (
+            lifecycle_config(tc, getattr(config, "lifecycle", ""))
+            if not self.sparse else None
+        )
+        self.lifecycle: Optional[LifecycleState] = (
+            LifecycleState(lc_cfg) if lc_cfg is not None else None
+        )
         # persistent padded predict scratch: the per-record, gang and
         # batched serve paths all pad rows into this reused buffer instead
         # of allocating a fresh pad batch per forecast record
@@ -342,6 +375,15 @@ class SpokeNet:
             flushed = self.batcher.flush_views()
             if flushed is not None:
                 self.node.on_training_batch(*flushed)
+                if (
+                    self.lifecycle is not None
+                    and self.lifecycle.training_active
+                ):
+                    # candidate twin-train on the SAME flushed batch; the
+                    # views alias batcher buffers that later adds reuse,
+                    # so the candidate gets copies (its fit is lazy)
+                    x, y, m = flushed
+                    self.lifecycle.fit_candidate(x.copy(), y.copy(), m)
             return
         flushed = self.batcher.flush()
         if flushed is not None:
@@ -353,6 +395,10 @@ class SpokeNet:
                     self.node.on_training_batch(x, y, mask)
             else:
                 self.node.on_training_batch(x, y, mask)
+            if self.lifecycle is not None and self.lifecycle.training_active:
+                # shadow/canary candidate trains on the same micro-batch
+                # (its own solo launch; the active model is untouched)
+                self.lifecycle.fit_candidate(x, y, mask)
 
     def test_arrays(self) -> Optional[Tuple[Any, np.ndarray, np.ndarray]]:
         if self.test_set.is_empty:
@@ -433,6 +479,10 @@ class Spoke:
         # the per-event guard walk is gated on this one flag so unarmed
         # jobs pay a single attribute read on the data path
         self._any_guard = False
+        # model-lifecycle plane: True once any hosted net is lifecycle-
+        # armed; gates the per-event candidate tick + the serve-admission
+        # canary routing the same way (one attribute read unarmed)
+        self._any_lifecycle = False
         # adaptive-batching serving plane (runtime/serving.py): created on
         # the first serving-armed net; the flag gates every hot-path hook
         # so serving-unset jobs pay one attribute read
@@ -463,6 +513,12 @@ class Spoke:
             self._delete(request.id)
         elif request.request == RequestType.QUERY:
             self._query(request)
+        elif request.request == RequestType.SHADOW:
+            self._lifecycle_shadow(request)
+        elif request.request == RequestType.PROMOTE:
+            self._lifecycle_promote_request(request)
+        elif request.request == RequestType.ROLLBACK:
+            self._lifecycle_rollback_request(request)
 
     def _create(self, request: Request, dim: int) -> None:
         if request.id in self.nets:
@@ -490,6 +546,8 @@ class Spoke:
             # a trip before the first cadence snapshot must still have a
             # rollback target
             net.pipeline.guard.maybe_snapshot(net.pipeline)
+        if net.lifecycle is not None:
+            self._any_lifecycle = True
         if self.cohorts is not None:
             self.cohorts.consider(net.pipeline)
             # pooled pipelines may attach on a LATER create (auto
@@ -657,6 +715,8 @@ class Spoke:
         self._flush_cohorts()
         # guard: evaluate the health results this record's launches noted
         self._guard_tick_all()
+        # lifecycle: candidate guard/score/ramp decisions for this record
+        self._lifecycle_tick_all()
         # overload: re-derive the pressure level from the queues this
         # record left behind, shed/drain accordingly (one flag read
         # unarmed) — BEFORE the serving poll so degraded limits apply at
@@ -731,6 +791,7 @@ class Spoke:
             self._process_packed_gang(gang_nets, x, y, f_idx)
         self._flush_cohorts()
         self._guard_tick_all()
+        self._lifecycle_tick_all()
         if ctl is not None:
             self._overload_tick()
         self.poll_serving()
@@ -836,6 +897,16 @@ class Spoke:
         if net.serving is not None:
             self._queue_packed(net, x, f_idx)
             return
+        f_idx = self._route_packed_candidates(net, x, f_idx)
+        if f_idx.size == 0:
+            return
+        self._serve_packed_baseline(net, x, f_idx)
+
+    def _serve_packed_baseline(
+        self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
+    ) -> None:
+        """Immediate packed-route serving through the ACTIVE model (the
+        canary split, when armed, already happened upstream)."""
         if net.sparse:
             sidx, sval = self._dense_rows_to_coo(x[f_idx], net.max_nnz)
             for j in range(f_idx.size):
@@ -872,6 +943,9 @@ class Spoke:
         """Admit packed-route forecast rows into the net's serving queue.
         Dense rows defer DataInstance construction to emission; sparse
         rows carry it (the payload features are the pre-COO dense row)."""
+        f_idx = self._route_packed_candidates(net, x, f_idx)
+        if f_idx.size == 0:
+            return
         plane = self.serving_plane
         if net.sparse:
             sidx, sval = self._dense_rows_to_coo(x[f_idx], net.max_nnz)
@@ -961,6 +1035,9 @@ class Spoke:
         # never report a NaN score off corrupt params the guard was about
         # to roll back
         self._guard_tick_all()
+        # ... and any pending lifecycle decision, so the registry view
+        # (and its counters) this response carries is settled too
+        self._lifecycle_tick_all()
         test = net.test_arrays()
         if test is not None:
             loss, score = net.pipeline.evaluate(*test)
@@ -1013,6 +1090,18 @@ class Spoke:
                 self._note_wire(nid, 0, "records_throttled", throttled)
             if ctl.level_peak:
                 self._note_wire(nid, 0, "pressure_level", ctl.level_peak)
+        # model-lifecycle telemetry: shadow/promotion/rollback counter
+        # deltas fold once (same once-semantics as the launch tally); the
+        # live version id is a max-combined GAUGE like pressureLevel
+        if self._note_wire is not None and net.lifecycle is not None:
+            for counter, n in net.lifecycle.take_counters().items():
+                self._note_wire(net.request.id, 0, counter, n)
+            # last-write gauge: always fold the CURRENT live version —
+            # including 0 after an operator rollback to the Create model
+            self._note_wire(
+                net.request.id, 0, "active_version",
+                net.lifecycle.active_version,
+            )
         desc = net.pipeline.describe()
         qstats = net.node.query_stats()
 
@@ -1044,6 +1133,14 @@ class Spoke:
                     loss=loss if i == 0 else None,
                     cumulative_loss=qstats["cumulative_loss"] if i == 0 else None,
                     score=score if i == 0 else None,
+                    # the worker's registry view (active version, canary
+                    # percentage, per-version shadow scores) rides the
+                    # bucket-0 fragment of lifecycle-armed pipelines
+                    lifecycle=(
+                        net.lifecycle.describe()
+                        if i == 0 and net.lifecycle is not None
+                        else None
+                    ),
                     source_worker=self.worker_id,
                 )
             )
@@ -1379,6 +1476,173 @@ class Spoke:
             # worker-keyed — the same repair on_stall performs).
             net.node.resend_state()
 
+    # --- model-lifecycle plane (runtime.lifecycle) -----------------------
+
+    def _lifecycle_shadow(self, request: Request) -> None:
+        """Shadow verb: register the request's candidate configuration and
+        enter shadow mode — the candidate trains on the same flushed
+        micro-batches and holdout-scores on the same test window, while
+        serving stays 100% on the active version.
+
+        The candidate must keep the baseline's flat-parameter SIZE (new
+        hyper-parameters, same architecture): a promotion swaps the
+        protocol node's pipeline, and the hub's model state — which a
+        promotion does not rebuild — would crash the next sync round on a
+        shape mismatch. A size-changing candidate quarantines instead of
+        arming (the operator's primitive for an architecture change
+        remains the destructive Update, as in the reference)."""
+        net = self.nets.get(request.id)
+        if net is None or net.lifecycle is None:
+            return
+        pipe, spec = build_candidate(
+            net, request, net.lifecycle.next_version
+        )
+        try:
+            cand_size = pipe.get_flat_params()[0].size
+            base_size = net.pipeline.get_flat_params()[0].size
+        except Exception:
+            cand_size = base_size = None  # host-side: no flat contract
+        if cand_size != base_size:
+            if self._quarantine is not None:
+                self._quarantine(
+                    "requests", request.to_json(), "rejected_request",
+                    detail=(
+                        "lifecycle candidate changes the parameter shape "
+                        f"({cand_size} vs {base_size}); use Update for "
+                        "architecture changes"
+                    ),
+                )
+            return
+        pipe.on_launch = net._note_launch
+        net.lifecycle.arm_shadow(pipe, spec)
+
+    def _lifecycle_promote_request(self, request: Request) -> None:
+        """Promote verb: a shadow candidate starts its canary traffic
+        ramp; a canarying candidate force-completes (operator override of
+        the remaining ramp — the auto-promotion checks are skipped, the
+        swap mechanics are identical)."""
+        net = self.nets.get(request.id)
+        if net is None or net.lifecycle is None:
+            return
+        entry = net.lifecycle.candidate_entry
+        if entry is None:
+            return
+        if entry.state == SHADOW:
+            net.lifecycle.start_canary()
+        elif entry.state == CANARY:
+            self._lifecycle_promote(net)
+
+    def _lifecycle_rollback_request(self, request: Request) -> None:
+        """Rollback verb: demote a live candidate (shadow or canary) —
+        routing snaps back to 100% baseline, which never rolled anywhere —
+        or, with no candidate in flight, reactivate the retained
+        pre-promotion version (undo of a completed promotion)."""
+        net = self.nets.get(request.id)
+        if net is None or net.lifecycle is None:
+            return
+        lc = net.lifecycle
+        if lc.candidate_entry is not None:
+            lc.demote_candidate(REASON_OPERATOR)
+            return
+        entry = lc.previous
+        if entry is None:
+            return
+        if net.serving is not None and net.serve_queue.entries:
+            # queued forecasts drain through the outgoing model first
+            self.serving_plane.flush_net(net)
+        if net.pipeline._cohort is not None and self.cohorts is not None:
+            self.cohorts.retire(net.pipeline)
+        net.node.pipeline = lc.reactivate(entry, net)
+        self._lifecycle_post_swap(net)
+
+    def _lifecycle_tick_all(self) -> None:
+        """Boundary decision pass for every net with a live candidate
+        (runs next to the guard tick): candidate guard trips and shadow-
+        score regressions roll the candidate back; a completed ramp
+        promotes it. One flag read when no hosted net is lifecycle-armed."""
+        if not self._any_lifecycle:
+            return
+        for net in list(self.nets.values()):
+            lc = net.lifecycle
+            if lc is None or lc.candidate is None:
+                continue
+            action = lc.tick(net)
+            if action is None:
+                continue
+            if action[0] == "rollback":
+                lc.demote_candidate(action[1])
+            else:
+                self._lifecycle_promote(net)
+
+    def _lifecycle_promote(self, net: SpokeNet) -> None:
+        """Runtime half of a promotion: drain the serving queue through
+        the outgoing model, detach it from its cohort (its state
+        materializes locally so the registry retains a live pipeline for
+        operator Rollback), swap the candidate in as the protocol node's
+        pipeline, and re-anchor transport/protocol state exactly like the
+        rescale model-seed path — the model was replaced wholesale."""
+        if net.serving is not None and net.serve_queue.entries:
+            self.serving_plane.flush_net(net)
+        if net.pipeline._cohort is not None and self.cohorts is not None:
+            self.cohorts.retire(net.pipeline)
+        net.node.pipeline = net.lifecycle.promote(net)
+        self._lifecycle_post_swap(net)
+
+    def _lifecycle_post_swap(self, net: SpokeNet) -> None:
+        """Shared tail of promote/reactivate: EF residuals and topk bases
+        computed against the replaced model are stale (same treatment as
+        the rescale grow-seed), drift baselines re-anchor, and the new
+        active model's guard — candidates always carry one — reseeds its
+        LKG ring at the promoted params (a rollback must never land on
+        the other version's snapshot)."""
+        if net.node.codec is not None:
+            net.node.codec.reset_streams()
+        net.node.on_model_seeded()
+        if net.pipeline.guard is not None:
+            self._any_guard = True
+            net.pipeline.guard.reseed(net.pipeline)
+
+    def _serve_candidate(self, net: SpokeNet, inst, row) -> None:
+        """Serve one canary-routed forecast through the candidate model —
+        immediately, never queued (the candidate is outside the serving
+        plane's exact-staleness contract; its own fit cadence makes the
+        padded solo predict trivially exact) — tagging the prediction
+        with the candidate version so operators (and the bitwise identity
+        gates) can separate candidate output from the active version's."""
+        lc = net.lifecycle
+        entry = lc.candidate_entry
+        t0 = time.perf_counter()
+        rows = np.asarray(row, np.float32).reshape(1, -1)
+        with self.serve_timer:
+            val = float(lc.predict_candidate(rows)[0])
+        self._emit_prediction(
+            Prediction(net.request.id, inst, val, version=entry.version)
+        )
+        net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
+
+    def _route_packed_candidates(
+        self, net: SpokeNet, x: np.ndarray, f_idx: np.ndarray
+    ) -> np.ndarray:
+        """Packed-route half of the canary split: walk the block's
+        forecast rows through the count-clocked router; candidate-routed
+        rows serve immediately through the candidate, the rest return for
+        the baseline path. Identity (no clock ticks) without an active
+        canary."""
+        lc = net.lifecycle
+        if lc is None or not lc.canary_active:
+            return f_idx
+        keep: List[int] = []
+        for f in f_idx:
+            f = int(f)
+            if lc.route_candidate():
+                row = self._adapt_width(x[f : f + 1], net.dim)[0]
+                self._serve_candidate(
+                    net, DataInstance.forecast_payload(row), row
+                )
+            else:
+                keep.append(f)
+        return np.asarray(keep, np.int64)
+
     def _process_packed_gang(self, nets, x, y, f_idx) -> None:
         """Lockstep twin of ``_process_packed_for_net`` over ALL nets:
         segments between forecasts gang-train, forecasts gang-serve at
@@ -1423,6 +1687,13 @@ class Spoke:
                 or net.sparse
                 or net.batcher.batch_size != b0
                 or len(net.batcher) != fill0
+                # an active canary needs the per-position walk: the
+                # count-clocked split is per forecast row, and a span
+                # admission would route whole blocks at once
+                or (
+                    net.lifecycle is not None
+                    and net.lifecycle.canary_active
+                )
             ):
                 return False
         n = x.shape[0]
@@ -1523,6 +1794,14 @@ class Spoke:
                 and not net.shared_taint
                 and net.dim == tx.shape[1]
                 and net.node.consumes_batch_synchronously
+                # a live shadow/canary candidate twin-trains at this
+                # net's OWN flush boundary (SpokeNet.flush_batch); the
+                # leader-batcher path bypasses it, so candidate-carrying
+                # nets keep the solo stride loop (bitwise identical)
+                and not (
+                    net.lifecycle is not None
+                    and net.lifecycle.training_active
+                )
             ):
                 groups.setdefault(cohort, []).append(net)
             else:
@@ -1590,6 +1869,18 @@ class Spoke:
         through one predict launch; emission keeps the nets order.
         Serving-armed nets queue instead (runtime/serving.py) and flush at
         the record boundary below when a queue filled."""
+        if self._any_lifecycle:
+            # canary split at the serve-admission boundary: candidate-
+            # routed forecasts serve through the candidate NOW; everything
+            # else takes the exact baseline path (queue or immediate)
+            kept = []
+            for net, x in entries:
+                lc = net.lifecycle
+                if lc is not None and lc.route_candidate():
+                    self._serve_candidate(net, inst, x)
+                else:
+                    kept.append((net, x))
+            entries = kept
         gang_in = []
         t0 = time.perf_counter()
         for net, x in entries:
@@ -1619,22 +1910,34 @@ class Spoke:
         position (gang predict for cohort members, the solo path
         otherwise, the serving queue for armed nets)."""
         gang_in = []
+        routed: set = set()
         t0 = time.perf_counter()
         for net in nets:
             if net.serving is not None:
+                # _queue_packed runs the canary split internally
                 self._queue_packed(net, x, np.asarray([f]))
-            elif net.gang_predict_ok():
+                continue
+            lc = net.lifecycle
+            if lc is not None and lc.canary_active and lc.route_candidate():
+                row = self._adapt_width(x[f : f + 1], net.dim)[0]
+                self._serve_candidate(
+                    net, DataInstance.forecast_payload(row), row
+                )
+                routed.add(id(net))
+                continue
+            if net.gang_predict_ok():
                 row = self._adapt_width(x[f : f + 1], net.dim)[0]
                 xb = net.predict_pad(1)
                 xb[0] = row
                 gang_in.append((net, xb))
         ganged = self._gang_predictions(gang_in) if gang_in else {}
         for net in nets:
-            if net.serving is not None:
+            if net.serving is not None or id(net) in routed:
                 continue
             pred = ganged.get(id(net))
             if pred is None:
-                self._serve_packed(net, x, np.asarray([f]))
+                # the split (if armed) already ran above — baseline only
+                self._serve_packed_baseline(net, x, np.asarray([f]))
             else:
                 row = self._adapt_width(x[f : f + 1], net.dim)[0]
                 inst = DataInstance(
@@ -1658,7 +1961,9 @@ class Spoke:
                     net, px, py, np.nonzero(pop != 0)[0]
                 )
             elif operation == FORECASTING:
-                if net.serving is not None:
+                if net.lifecycle is not None and net.lifecycle.route_candidate():
+                    self._serve_candidate(net, inst, x)
+                elif net.serving is not None:
                     self.serving_plane.admit(net, inst, x)
                 else:
                     self._serve(net, inst, x)
@@ -1725,6 +2030,8 @@ class Spoke:
                 self.nets[net_id] = rnet
                 if rnet.pipeline.guard is not None:
                     self._any_guard = True
+                if rnet.lifecycle is not None:
+                    self._any_lifecycle = True
                 if rnet.serving is not None:
                     # re-home the queue plumbing: the retired spoke's plane
                     # (already flushed above) is gone with its owner
@@ -1774,6 +2081,15 @@ class Spoke:
             # must not undo the absorbed replica's contribution
             if snet.pipeline.guard is not None:
                 snet.pipeline.guard.reseed(snet.pipeline)
+            # lifecycle: the retiring replica's candidate (if any) retires
+            # with its spoke — its registry row is released silently, not
+            # counted as a rollback — and its un-folded counter deltas
+            # carry over to the survivor like the overload counters do
+            if rnet.lifecycle is not None:
+                rnet.lifecycle.demote_candidate(None)
+                if snet.lifecycle is not None:
+                    for k, v in rnet.lifecycle.take_counters().items():
+                        snet.lifecycle._bump(k, v)
             # holdout windows interleave (keep-newest overflow), the same
             # merge the reference's rescale uses (CommonUtils.scala:36-48)
             snet.test_set.merge([rnet.test_set])

@@ -1,0 +1,999 @@
+"""Model-lifecycle plane (runtime/lifecycle.py).
+
+Pins, per ISSUE 11 acceptance:
+
+- ``lifecycle`` unset runs the exact pre-plane routes — zero lifecycle
+  objects anywhere — across the composition matrix (cohort x codec int8
+  x guard x serving exact x overload), and an ARMED-but-idle registry
+  (no Shadow issued) is bit-identical to unarmed;
+- with a canary armed, baseline-version (untagged) predictions stay
+  BITWISE equal to a no-lifecycle run — candidate training and canary
+  routing never perturb the active model;
+- the canary split is a deterministic, seeded, count-clocked hash of the
+  forecast stream (same seed => same route schedule, replayable);
+- a healthy Shadow candidate ramps and auto-promotes, retaining the
+  outgoing version for operator Rollback; a poisoned candidate trips its
+  guard (or regresses past scoreEnvelope) and auto-rolls-back with zero
+  forecast loss — healthy co-tenants serve EXACTLY their no-canary
+  forecast counts;
+- the registry, candidate state and canary clocks persist through
+  checkpoint/restore: a supervised restart mid-ramp converges to the
+  fault-free promotion decision;
+- Statistics plumbing (shadowScored / canaryPromotions / canaryRollbacks
+  / activeVersion gauge), the Query-response registry view, and the
+  tenant_topology() lifecycle section.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from omldm_tpu.api.data import FORECASTING, DataInstance, Prediction
+from omldm_tpu.api.requests import TrainingConfiguration
+from omldm_tpu.api.responses import QueryResponse
+from omldm_tpu.api.stats import Statistics
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime import StreamJob
+from omldm_tpu.runtime.job import (
+    FORECASTING_STREAM,
+    REQUEST_STREAM,
+    TRAINING_STREAM,
+)
+from omldm_tpu.runtime.lifecycle import (
+    ACTIVE,
+    CANARY,
+    REGISTERED,
+    ROLLED_BACK,
+    SHADOW,
+    LifecycleConfig,
+    LifecycleState,
+    canary_hash,
+    lifecycle_config,
+    parse_lifecycle_spec,
+    validate_lifecycle,
+)
+from omldm_tpu.runtime.recovery import (
+    FaultInjector,
+    JobSupervisor,
+    replayable,
+)
+
+DIM = 8
+
+# a ramp small enough that a ~300-record (150-forecast) stream completes
+# it: full ramp at clock 16, promotion after 16 canary serves + 1 eval
+LC = {
+    "rampFrom": 0.0, "rampTo": 0.5, "rampEvery": 8, "rampStep": 0.25,
+    "promoteAfter": 16, "shadowEvery": 4, "minShadowEvals": 1,
+    "scoreEnvelope": 0.05, "seed": 7,
+}
+
+
+# --- config parsing / validation ---------------------------------------------
+
+
+class TestLifecycleConfig:
+    def test_unset_is_none(self):
+        assert parse_lifecycle_spec(None) is None
+        assert parse_lifecycle_spec(False) is None
+        assert parse_lifecycle_spec("") is None
+        assert lifecycle_config(TrainingConfiguration()) is None
+
+    def test_defaults_and_spec_strings(self):
+        assert parse_lifecycle_spec(True) == LifecycleConfig()
+        assert parse_lifecycle_spec("on") == LifecycleConfig()
+        cfg = parse_lifecycle_spec("rampTo=0.4,rampEvery=64,seed=9")
+        assert (cfg.ramp_to, cfg.ramp_every, cfg.seed) == (0.4, 64, 9)
+        cfg = parse_lifecycle_spec(LC)
+        assert (cfg.ramp_from, cfg.ramp_to, cfg.promote_after,
+                cfg.shadow_every, cfg.min_shadow_evals,
+                cfg.score_envelope) == (0.0, 0.5, 16, 4, 1, 0.05)
+
+    def test_job_default_and_per_pipeline_override(self):
+        tc = TrainingConfiguration()
+        assert lifecycle_config(tc, "rampTo=0.25").ramp_to == 0.25
+        tc_off = TrainingConfiguration(extra={"lifecycle": False})
+        assert lifecycle_config(tc_off, "rampTo=0.25") is None
+        tc_own = TrainingConfiguration(extra={"lifecycle": {"rampTo": 0.75}})
+        assert lifecycle_config(tc_own, "rampTo=0.25").ramp_to == 0.75
+
+    @pytest.mark.parametrize("bad", [
+        {"rampFrom": 0.6, "rampTo": 0.4}, {"rampTo": 1.5},
+        {"rampEvery": 0}, {"rampStep": 0}, {"promoteAfter": 0},
+        {"shadowEvery": 0}, {"minShadowEvals": -1},
+        {"scoreEnvelope": -0.1}, {"maxVersions": 1},
+        {"notAKnob": 1}, "rampTo", 7,
+    ])
+    def test_invalid_specs_raise_and_gate(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            parse_lifecycle_spec(bad)
+        req = _create_req(0, lifecycle=bad)
+        assert validate_lifecycle(req) is not None
+
+    def test_sparse_and_spmd_rejected(self):
+        req = _create_req(0, lifecycle=LC)
+        req.learner.data_structure = {"nFeatures": DIM, "sparse": True}
+        assert "dense" in validate_lifecycle(req)
+        req = _create_req(0, lifecycle=LC)
+        req.training_configuration.extra["engine"] = "spmd"
+        assert "host-plane" in validate_lifecycle(req)
+
+    def test_bad_request_quarantined_not_fatal(self):
+        job = StreamJob(JobConfig(parallelism=1))
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": DIM}},
+            "trainingConfiguration": {"lifecycle": {"rampEvery": 0}},
+        }))
+        assert 0 not in job.pipeline_manager.node_map
+        assert "rejected_request" in [
+            e["reason"] for e in job.dead_letter.entries
+        ]
+
+    def test_bad_job_default_fails_fast(self):
+        with pytest.raises(ValueError):
+            StreamJob(JobConfig(parallelism=1, lifecycle="rampStep=0"))
+
+
+class TestCanaryHash:
+    def test_deterministic_and_bounded(self):
+        a = [canary_hash(7, n) for n in range(512)]
+        b = [canary_hash(7, n) for n in range(512)]
+        assert a == b
+        assert all(0.0 <= v < 1.0 for v in a)
+
+    def test_seed_and_clock_sensitivity(self):
+        assert canary_hash(7, 0) != canary_hash(8, 0)
+        assert len({canary_hash(7, n) for n in range(64)}) == 64
+
+    def test_roughly_uniform(self):
+        hits = sum(canary_hash(3, n) < 0.5 for n in range(4096))
+        assert abs(hits / 4096 - 0.5) < 0.05
+
+
+# --- registry state machine (policy units, no runtime) ------------------------
+
+
+def _armed_state(**kw):
+    spec = dict(LC)
+    spec.update(kw)
+    state = LifecycleState(parse_lifecycle_spec(spec))
+    return state
+
+
+class _FakePipe:
+    """Registry-row stand-in: flat params + a version tag slot."""
+
+    def __init__(self, val=1.0):
+        self._flat = np.full((4,), val, np.float32)
+        self.version = 0
+        self.guard = None
+
+    def get_flat_params(self):
+        return self._flat.copy(), None
+
+
+class TestStateMachine:
+    def test_version_zero_active(self):
+        lc = _armed_state()
+        assert lc.active_version == 0
+        assert lc.versions[0].state == ACTIVE
+        assert lc.candidate is None and not lc.training_active
+
+    def test_shadow_then_canary(self):
+        lc = _armed_state()
+        v = lc.arm_shadow(_FakePipe(), {"learner": {}})
+        assert v == 1 and lc.candidate == 1
+        assert lc.versions[1].state == SHADOW
+        assert lc.training_active and not lc.canary_active
+        assert lc.start_canary()
+        assert lc.versions[1].state == CANARY and lc.canary_active
+        assert not lc.start_canary()  # already canarying
+
+    def test_reissued_shadow_replaces_silently(self):
+        lc = _armed_state()
+        lc.arm_shadow(_FakePipe(), {})
+        lc.arm_shadow(_FakePipe(), {})
+        assert lc.candidate == 2
+        assert lc.versions[1].state == REGISTERED
+        assert lc.versions[1].trip_reason is None
+        assert lc.totals["canary_rollbacks"] == 0
+
+    def test_demote_counts_rollback_and_releases_pipeline(self):
+        lc = _armed_state()
+        lc.arm_shadow(_FakePipe(2.0), {})
+        entry = lc.demote_candidate("non_finite")
+        assert entry.state == ROLLED_BACK
+        assert entry.trip_reason == "non_finite"
+        assert entry.pipeline is None
+        assert entry.flat is not None and entry.flat[0] == 2.0
+        assert lc.candidate is None and lc.canary_pct == 0.0
+        assert lc.totals["canary_rollbacks"] == 1
+
+    def test_route_clock_deterministic_and_ramping(self):
+        lc = _armed_state(rampFrom=0.5, rampTo=0.5)
+        lc.arm_shadow(_FakePipe(), {})
+        lc.candidate_entry.fits = 1  # a trained candidate
+        lc.start_canary()
+        takes = [lc.route_candidate() for _ in range(256)]
+        # pure function of (seed, clock): an identical registry replays
+        # the identical schedule
+        lc2 = _armed_state(rampFrom=0.5, rampTo=0.5)
+        lc2.arm_shadow(_FakePipe(), {})
+        lc2.candidate_entry.fits = 1
+        lc2.start_canary()
+        assert [lc2.route_candidate() for _ in range(256)] == takes
+        frac = sum(takes) / 256
+        assert 0.35 < frac < 0.65
+        assert lc.versions[lc.candidate].canary_served == sum(takes)
+
+    def test_ramp_steps_on_clock(self):
+        lc = _armed_state()  # rampEvery=8, step 0.25, to 0.5
+        lc.arm_shadow(_FakePipe(), {})
+        lc.candidate_entry.fits = 1
+        lc.start_canary()
+        assert lc.canary_pct == 0.0
+        for _ in range(9):
+            lc.route_candidate()
+        assert lc.canary_pct == 0.25
+        for _ in range(16):
+            lc.route_candidate()
+        assert lc.canary_pct == 0.5  # capped at rampTo
+
+    def test_untrained_candidate_never_takes_traffic(self):
+        """A canary whose candidate has zero fits (a spoke whose stream
+        share carried no training rows) serves nothing — init-model
+        predictions are never exposed — while the clock still ticks so
+        the hash schedule stays aligned with the forecast count."""
+        lc = _armed_state(rampFrom=0.5, rampTo=0.5)
+        lc.arm_shadow(_FakePipe(), {})
+        lc.start_canary()
+        assert not any(lc.route_candidate() for _ in range(64))
+        assert lc.forecast_clock == 64
+        lc.candidate_entry.fits = 1
+        assert any(lc.route_candidate() for _ in range(16))
+
+    def test_registry_trim_bound(self):
+        lc = _armed_state(maxVersions=3)
+        for _ in range(6):
+            lc.arm_shadow(_FakePipe(), {})
+            lc.demote_candidate(None, to_state=REGISTERED)
+        assert len(lc.versions) <= 3
+        assert 0 in lc.versions  # the active version never trims
+
+    def test_take_counters_drains_once(self):
+        lc = _armed_state()
+        lc.arm_shadow(_FakePipe(), {})
+        lc.demote_candidate("operator")
+        assert lc.take_counters() == {"canary_rollbacks": 1}
+        assert lc.take_counters() == {}
+        assert lc.totals["canary_rollbacks"] == 1  # totals survive
+
+
+# --- job harness -------------------------------------------------------------
+
+
+def _create_req(pid, lifecycle=None, **tc_extra):
+    from omldm_tpu.api.requests import Request
+
+    tc = {"protocol": "Asynchronous", "syncEvery": 4, **tc_extra}
+    if lifecycle is not None:
+        tc["lifecycle"] = lifecycle
+    return Request.from_dict({
+        "id": pid, "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                    "dataStructure": {"nFeatures": DIM}},
+        "trainingConfiguration": tc,
+    })
+
+
+def _job(lifecycle=None, n_pipe=1, serving=None, cohort="off", codec=None,
+         guard=False, overload=None, protocol="Asynchronous", parallelism=1,
+         test=True, job_lifecycle="", batch=16):
+    cfg = JobConfig(parallelism=parallelism, batch_size=batch,
+                    test_set_size=16, cohort=cohort, cohort_min=2,
+                    test=test, lifecycle=job_lifecycle)
+    job = StreamJob(cfg)
+    for pid in range(n_pipe):
+        tc = {"protocol": protocol, "syncEvery": 4}
+        if lifecycle is not None:
+            tc["lifecycle"] = lifecycle
+        if serving is not None:
+            tc["serving"] = serving
+        if overload is not None:
+            tc["overload"] = overload
+        if codec:
+            tc["comm"] = {"codec": codec}
+        if guard:
+            tc["guard"] = True
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": pid, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": DIM}},
+            "trainingConfiguration": tc,
+        }))
+    return job
+
+
+def _shadow(job, pid=0, C=0.5, learner="PA"):
+    job.process_event(REQUEST_STREAM, json.dumps({
+        "id": pid, "request": "Shadow",
+        "learner": {"name": learner, "hyperParameters": {"C": C},
+                    "dataStructure": {"nFeatures": DIM}},
+    }))
+
+
+def _promote(job, pid=0):
+    job.process_event(REQUEST_STREAM, json.dumps(
+        {"id": pid, "request": "Promote"}))
+
+
+def _rollback(job, pid=0):
+    job.process_event(REQUEST_STREAM, json.dumps(
+        {"id": pid, "request": "Rollback"}))
+
+
+def _feed(job, records=320, seed=3, terminate=True):
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(5).randn(DIM)
+    for i in range(records):
+        f = rng.randn(DIM).astype(np.float32)
+        if i % 2 == 0:
+            job.process_event(FORECASTING_STREAM, json.dumps(
+                {"numericalFeatures": f.tolist()}))
+        else:
+            job.process_event(TRAINING_STREAM, json.dumps(
+                {"numericalFeatures": f.tolist(),
+                 "target": float(f @ w > 0)}))
+    return job.terminate() if terminate else None
+
+
+def _digest(job, report):
+    ordered = {}
+    for p in job.predictions:
+        feats = tuple(np.asarray(p.data_instance.numerical_features).tolist())
+        ordered.setdefault(p.mlp_id, []).append((feats, p.value, p.version))
+    scores = {s.pipeline: s.score for s in report.statistics}
+    return ordered, scores
+
+
+def _per_net_preds(job):
+    """Per-net prediction sequence (value, version), in stream order."""
+    out = {}
+    for p in job.predictions:
+        out.setdefault(p.mlp_id, []).append((p.value, p.version))
+    return out
+
+
+# --- unset identity (the composition matrix) ---------------------------------
+
+
+MATRIX = [
+    dict(),
+    dict(cohort="on", n_pipe=4),
+    dict(codec="int8"),
+    dict(guard=True),
+    dict(serving={"maxBatch": 8, "maxDelayMs": 200.0}),
+    dict(overload="window=8,share=2,hotHigh=6,hotCritical=12"),
+    dict(cohort="on", n_pipe=4, codec="int8", guard=True,
+         serving={"maxBatch": 8, "maxDelayMs": 200.0}),
+]
+
+
+class TestUnsetIdentity:
+    @pytest.mark.parametrize("kw", MATRIX)
+    def test_no_lifecycle_objects_when_unset(self, kw):
+        job = _job(None, **kw)
+        _feed(job, records=64)
+        for spoke in job.spokes:
+            assert not spoke._any_lifecycle
+            for net in spoke.nets.values():
+                assert net.lifecycle is None
+
+    @pytest.mark.parametrize("kw", MATRIX)
+    def test_armed_idle_bit_identical(self, kw):
+        """An armed registry with no Shadow issued must not perturb a
+        single bit of the stream (no candidate => no twin training, no
+        routing ticks, no extra launches on the data path)."""
+        off = _job(None, **kw)
+        d_off = _digest(off, _feed(off))
+        on = _job(LC, **kw)
+        d_on = _digest(on, _feed(on))
+        assert d_off == d_on
+        for spoke in on.spokes:
+            for net in spoke.nets.values():
+                assert net.lifecycle is not None
+                assert net.lifecycle.describe()["counters"] == {
+                    "shadow_scored": 0, "canary_promotions": 0,
+                    "canary_rollbacks": 0,
+                }
+
+    def test_job_default_arms_every_pipeline(self):
+        job = _job(None, n_pipe=3, job_lifecycle="rampTo=0.25")
+        for spoke in job.spokes:
+            for net in spoke.nets.values():
+                assert net.lifecycle is not None
+                assert net.lifecycle.cfg.ramp_to == 0.25
+
+    def test_armed_parallel_2_identity(self):
+        off = _job(None, protocol="Synchronous", parallelism=2)
+        d_off = _digest(off, _feed(off))
+        on = _job(LC, protocol="Synchronous", parallelism=2)
+        d_on = _digest(on, _feed(on))
+        assert d_off == d_on
+
+
+class TestCanaryBaselineIdentity:
+    @pytest.mark.parametrize("kw", [
+        dict(),
+        dict(serving={"maxBatch": 8, "maxDelayMs": 200.0}),
+        dict(guard=True, codec="int8"),
+    ])
+    def test_baseline_predictions_bitwise_under_canary(self, kw):
+        """With a canary serving traffic the whole run (promoteAfter past
+        the stream), every BASELINE-version prediction must be bitwise
+        the no-lifecycle run's value at the same per-net stream position:
+        candidate twin-training and hash routing never touch the active
+        model, its batcher, or its holdout cycle."""
+        off = _job(None, **kw)
+        _feed(off)
+        on = _job({**LC, "promoteAfter": 100_000}, **kw)
+        _shadow(on)
+        _promote(on)  # canary starts; never completes
+        _feed(on)
+        # candidate-routed forecasts serve immediately while baseline
+        # forecasts may sit in a serving queue, so emission order can
+        # interleave differently; pair by the record's (unique random)
+        # feature payload instead of the emission index
+        off_vals = {}
+        for p in off.predictions:
+            key = (p.mlp_id,
+                   tuple(np.float32(p.data_instance.numerical_features)))
+            off_vals[key] = p.value
+        tagged = 0
+        assert len(on.predictions) == len(off.predictions)  # zero loss
+        for p in on.predictions:
+            if p.version is not None:
+                tagged += 1
+                continue
+            key = (p.mlp_id,
+                   tuple(np.float32(p.data_instance.numerical_features)))
+            assert p.value == off_vals[key]
+        assert tagged > 0  # the canary actually served
+
+    def test_same_seed_same_route_schedule(self):
+        runs = []
+        for _ in range(2):
+            job = _job({**LC, "promoteAfter": 100_000})
+            _shadow(job)
+            _promote(job)
+            _feed(job)
+            runs.append([ver for _v, ver in _per_net_preds(job)[0]])
+        assert runs[0] == runs[1]
+        job = _job({**LC, "promoteAfter": 100_000, "seed": 99})
+        _shadow(job)
+        _promote(job)
+        _feed(job)
+        other = [ver for _v, ver in _per_net_preds(job)[0]]
+        assert other != runs[0]
+
+
+# --- shadow scoring / promotion ----------------------------------------------
+
+
+class TestShadowAndPromotion:
+    def test_shadow_trains_and_scores_without_serving(self):
+        job = _job(LC)
+        _shadow(job)
+        _feed(job, terminate=False)
+        lc = job.spokes[0].nets[0].lifecycle
+        entry = lc.candidate_entry
+        assert entry.state == SHADOW
+        assert entry.fits > 0 and entry.shadow_evals > 0
+        assert entry.shadow_score is not None
+        assert entry.canary_served == 0
+        # serving stayed 100% on the active version
+        assert all(p.version is None for p in job.predictions)
+        job.terminate()
+
+    def test_healthy_candidate_auto_promotes(self):
+        job = _job(LC)
+        _shadow(job)
+        _promote(job)
+        report = _feed(job)
+        lc = job.spokes[0].nets[0].lifecycle.describe()
+        assert lc["activeVersion"] == 1
+        assert lc["candidateVersion"] is None
+        states = {v["version"]: v["state"] for v in lc["versions"]}
+        assert states[1] == ACTIVE
+        assert states[0] == REGISTERED  # retained for operator Rollback
+        [stats] = report.statistics
+        assert stats.canary_promotions == 1
+        assert stats.canary_rollbacks == 0
+        assert stats.shadow_scored >= 1
+        assert stats.active_version == 1
+
+    def test_promoted_model_serves_after_swap(self):
+        """After promotion the (previously candidate) pipeline IS the
+        serving model: the node's pipeline object carries the candidate
+        version tag and subsequent predictions are untagged (it is the
+        active version now, not a canary)."""
+        job = _job(LC)
+        _shadow(job)
+        _promote(job)
+        _feed(job, terminate=False)
+        net = job.spokes[0].nets[0]
+        assert net.pipeline.version == 1
+        n_before = len(job.predictions)
+        job.process_event(FORECASTING_STREAM, json.dumps(
+            {"numericalFeatures": [0.1] * DIM}))
+        assert len(job.predictions) == n_before + 1
+        assert job.predictions[-1].version is None
+        job.terminate()
+
+    def test_score_regression_rolls_back(self):
+        """A candidate whose holdout score regresses past scoreEnvelope
+        demotes without any guard trip: the C=1e-6 PA candidate barely
+        learns while the baseline converges."""
+        job = _job(LC)
+        _shadow(job, C=1e-6)
+        _feed(job, records=480, terminate=False)
+        lc = job.spokes[0].nets[0].lifecycle
+        entry = lc.versions[1]
+        assert entry.state == ROLLED_BACK
+        assert entry.trip_reason == "score_regressed"
+        assert lc.active_version == 0
+        report = job.terminate()
+        [stats] = report.statistics
+        assert stats.canary_rollbacks == 1
+        assert stats.canary_promotions == 0
+
+    def test_production_mode_needs_min_shadow_evals_zero(self):
+        """test=False has no holdout, so shadow scoring cannot run; the
+        documented escape hatch (minShadowEvals=0) still promotes."""
+        job = _job({**LC, "minShadowEvals": 0}, test=False)
+        _shadow(job)
+        _promote(job)
+        _feed(job)
+        assert job.spokes[0].nets[0].lifecycle.active_version == 1
+
+
+# --- guard-fenced rollback ----------------------------------------------------
+
+
+def _poison_candidate(job, pid=0, value=1.0e9):
+    entry = job.spokes[0].nets[pid].lifecycle.candidate_entry
+    flat, _ = entry.pipeline.get_flat_params()
+    entry.pipeline.set_flat_params(np.full_like(flat, value))
+
+
+class TestGuardFencedRollback:
+    def _poisoned_run(self, n_pipe=1, poison_at=120, **kw):
+        job = _job(LC, n_pipe=n_pipe, **kw)
+        _shadow(job)
+        _promote(job)
+        rng = np.random.RandomState(3)
+        w = np.random.RandomState(5).randn(DIM)
+        for i in range(320):
+            if i == poison_at:
+                _poison_candidate(job)
+            f = rng.randn(DIM).astype(np.float32)
+            if i % 2 == 0:
+                job.process_event(FORECASTING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist()}))
+            else:
+                job.process_event(TRAINING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist(),
+                     "target": float(f @ w > 0)}))
+        return job
+
+    def test_poisoned_candidate_rolls_back_via_guard(self):
+        job = self._poisoned_run()
+        lc = job.spokes[0].nets[0].lifecycle
+        entry = lc.versions[1]
+        assert entry.state == ROLLED_BACK
+        assert entry.trip_reason in ("non_finite", "norm_exploded")
+        assert lc.active_version == 0
+        report = job.terminate()
+        [stats] = report.statistics
+        assert stats.canary_rollbacks == 1 and stats.canary_promotions == 0
+        assert stats.active_version == 0
+
+    def test_rollback_restores_baseline_serving_bitwise(self):
+        """After the rollback every subsequent forecast serves through
+        the untouched baseline: the full untagged prediction sequence is
+        bitwise the no-canary run's, and not one forecast is lost."""
+        off = _job(None)
+        _feed(off)
+        on = self._poisoned_run()
+        p_off, p_on = _per_net_preds(off)[0], _per_net_preds(on)[0]
+        assert len(p_on) == len(p_off)  # zero forecast loss
+        assert sum(1 for _v, ver in p_on if ver is not None) > 0
+        for (v0, _), (v1, ver) in zip(p_off, p_on):
+            if ver is None:
+                assert v1 == v0
+        # the rollback point splits the stream: after it, EVERY forecast
+        # is baseline-served (routing snapped to 100% baseline)
+        last_tagged = max(
+            i for i, (_v, ver) in enumerate(p_on) if ver is not None
+        )
+        assert all(ver is None for _v, ver in p_on[last_tagged + 1:])
+        on.terminate()
+
+    def test_healthy_cotenants_keep_exact_forecast_counts(self):
+        """The ISSUE 11 blast-radius pin: tenants WITHOUT a canary serve
+        exactly their no-canary forecast counts (and values) while
+        tenant 0's poisoned candidate trips and rolls back."""
+        off = _job(None, n_pipe=4)
+        r_off = _feed(off)
+        on = self._poisoned_run(n_pipe=4)
+        r_on = on.terminate()
+        off_served = {s.pipeline: s.forecasts_served
+                      for s in r_off.statistics}
+        on_served = {s.pipeline: s.forecasts_served
+                     for s in r_on.statistics}
+        for pid in (1, 2, 3):
+            assert on_served[pid] == off_served[pid]
+        p_off, p_on = _per_net_preds(off), _per_net_preds(on)
+        for pid in (1, 2, 3):
+            assert p_on[pid] == p_off[pid]
+        by_pipe = {s.pipeline: s for s in r_on.statistics}
+        assert by_pipe[0].canary_rollbacks == 1
+
+
+# --- operator verbs -----------------------------------------------------------
+
+
+class TestOperatorVerbs:
+    def test_rollback_demotes_live_candidate(self):
+        job = _job(LC)
+        _shadow(job)
+        _feed(job, records=64, terminate=False)
+        _rollback(job)
+        lc = job.spokes[0].nets[0].lifecycle
+        assert lc.candidate is None
+        assert lc.versions[1].state == ROLLED_BACK
+        assert lc.versions[1].trip_reason == "operator"
+        job.terminate()
+
+    def test_rollback_after_promotion_reactivates_previous(self):
+        job = _job(LC)
+        _shadow(job)
+        _promote(job)
+        _feed(job, records=320, terminate=False)
+        net = job.spokes[0].nets[0]
+        assert net.lifecycle.active_version == 1
+        flat_promoted, _ = net.pipeline.get_flat_params()
+        _rollback(job)
+        lc = net.lifecycle
+        assert lc.active_version == 0
+        assert net.pipeline.version == 0
+        states = {v.version: v.state for v in lc.versions.values()}
+        assert states[0] == ACTIVE and states[1] == ROLLED_BACK
+        flat_back, _ = net.pipeline.get_flat_params()
+        assert not np.array_equal(flat_back, flat_promoted)
+        job.terminate()
+
+    def test_promote_on_canary_force_completes(self):
+        job = _job({**LC, "promoteAfter": 100_000})
+        _shadow(job)
+        _promote(job)  # shadow -> canary
+        _feed(job, records=160, terminate=False)
+        assert job.spokes[0].nets[0].lifecycle.active_version == 0
+        _promote(job)  # canary -> active, operator override of the ramp
+        assert job.spokes[0].nets[0].lifecycle.active_version == 1
+        job.terminate()
+
+    def test_verbs_on_unarmed_pipeline_quarantined(self):
+        job = _job(None)
+        _shadow(job)
+        assert job.spokes[0].nets[0].lifecycle is None
+        entries = [e for e in job.dead_letter.entries
+                   if e["reason"] == "rejected_request"]
+        assert any("not armed" in (e.get("detail") or "") for e in entries)
+
+    def test_verbs_on_missing_pipeline_quarantined(self):
+        job = _job(LC)
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 9, "request": "Promote"}))
+        assert any(e["reason"] == "rejected_request"
+                   for e in job.dead_letter.entries)
+
+    def test_shadow_with_sparse_candidate_rejected(self):
+        job = _job(LC)
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Shadow",
+            "learner": {"name": "PA", "hyperParameters": {"C": 0.5},
+                        "dataStructure": {"nFeatures": DIM,
+                                          "sparse": True}},
+        }))
+        assert job.spokes[0].nets[0].lifecycle.candidate is None
+        assert any(e["reason"] == "rejected_request"
+                   for e in job.dead_letter.entries)
+
+    def test_shadow_without_learner_rejected(self):
+        job = _job(LC)
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Shadow"}))
+        assert job.spokes[0].nets[0].lifecycle.candidate is None
+
+    def test_shape_changing_candidate_quarantined(self):
+        """A candidate whose flat-parameter size differs from the
+        baseline's (here: a PolynomialFeatures chain widening the learner
+        dim) must quarantine instead of arming — a promotion would hand
+        the protocol's next sync round mismatched shapes. Architecture
+        changes stay on the destructive Update path."""
+        job = _job(LC)
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Shadow",
+            "learner": {"name": "PA", "hyperParameters": {"C": 0.5},
+                        "dataStructure": {"nFeatures": DIM}},
+            "preProcessors": [{"name": "PolynomialFeatures",
+                               "hyperParameters": {"degree": 2}}],
+        }))
+        assert job.spokes[0].nets[0].lifecycle.candidate is None
+        entries = [e for e in job.dead_letter.entries
+                   if e["reason"] == "rejected_request"]
+        assert any("parameter shape" in (e.get("detail") or "")
+                   for e in entries)
+
+    def test_sparse_pipeline_job_default_verbs_quarantined(self):
+        """A job-wide lifecycle default does not arm sparse nets (the
+        candidate paths are dense); a verb aimed at one quarantines at
+        the job instead of vanishing spoke-side."""
+        job = StreamJob(JobConfig(parallelism=1, lifecycle="on"))
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 64, "sparse": True,
+                                          "maxNnz": 8}},
+            "trainingConfiguration": {"protocol": "Asynchronous"},
+        }))
+        assert 0 in job.pipeline_manager.node_map
+        assert job.spokes[0].nets[0].lifecycle is None
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Promote"}))
+        entries = [e for e in job.dead_letter.entries
+                   if e["reason"] == "rejected_request"]
+        assert any("not armed" in (e.get("detail") or "") for e in entries)
+
+
+# --- checkpoint / kill-recovery ----------------------------------------------
+
+
+def _events(n=2_000, lifecycle=LC, shadow_C=0.5):
+    rng = np.random.RandomState(3)
+    w = np.random.RandomState(5).randn(DIM)
+    x = rng.randn(n, DIM).astype(np.float32)
+
+    def gen():
+        yield REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": DIM}},
+            "trainingConfiguration": {"protocol": "Asynchronous",
+                                      "syncEvery": 4,
+                                      "lifecycle": lifecycle},
+        })
+        yield REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Shadow",
+            "learner": {"name": "PA", "hyperParameters": {"C": shadow_C},
+                        "dataStructure": {"nFeatures": DIM}},
+        })
+        yield REQUEST_STREAM, json.dumps({"id": 0, "request": "Promote"})
+        for i in range(n):
+            if i % 2 == 0:
+                yield FORECASTING_STREAM, DataInstance(
+                    numerical_features=x[i].tolist(),
+                    operation=FORECASTING)
+            else:
+                yield TRAINING_STREAM, DataInstance(
+                    numerical_features=x[i].tolist(),
+                    target=float(x[i] @ w > 0))
+
+    return gen
+
+
+class TestCheckpointRecovery:
+    def test_snapshot_roundtrip_mid_canary(self, tmp_path):
+        from omldm_tpu.checkpoint import CheckpointManager
+
+        job = StreamJob(JobConfig(
+            parallelism=1, batch_size=16, test_set_size=16,
+            checkpointing=True, checkpoint_dir=str(tmp_path)))
+        held = {**LC, "promoteAfter": 100_000}  # stay mid-ramp
+        for stream, payload in _events(400, lifecycle=held)():
+            job.process_event(stream, payload)
+        lc = job.spokes[0].nets[0].lifecycle
+        assert lc.canary_active  # mid-ramp
+        view = lc.describe()
+        cand_flat, _ = lc.candidate_entry.pipeline.get_flat_params()
+        path = job.checkpoint_manager.save(job)
+        restored = CheckpointManager(str(tmp_path)).restore(path=path)
+        rlc = restored.spokes[0].nets[0].lifecycle
+        assert rlc.describe() == view  # registry, clocks, counters
+        rflat, _ = rlc.candidate_entry.pipeline.get_flat_params()
+        np.testing.assert_array_equal(rflat, cand_flat)
+        # the candidate's guard survived too (its ring fences the canary)
+        assert rlc.candidate_entry.pipeline.guard is not None
+
+    def test_restore_after_promotion_installs_promoted_pipeline(
+        self, tmp_path
+    ):
+        from omldm_tpu.checkpoint import CheckpointManager
+
+        job = StreamJob(JobConfig(
+            parallelism=1, batch_size=16, test_set_size=16,
+            checkpointing=True, checkpoint_dir=str(tmp_path)))
+        for stream, payload in _events(1_200)():
+            job.process_event(stream, payload)
+        net = job.spokes[0].nets[0]
+        assert net.lifecycle.active_version == 1  # promoted mid-stream
+        flat, _ = net.pipeline.get_flat_params()
+        path = job.checkpoint_manager.save(job)
+        restored = CheckpointManager(str(tmp_path)).restore(path=path)
+        rnet = restored.spokes[0].nets[0]
+        assert rnet.lifecycle.active_version == 1
+        assert rnet.pipeline.version == 1
+        # the promoted-spec pipeline carries the promoted params (not the
+        # Create-spec model the deploy constructed)
+        rflat, _ = rnet.pipeline.get_flat_params()
+        np.testing.assert_array_equal(rflat, flat)
+        assert rnet.pipeline.learner.hp["C"] == 0.5
+        # the retained version 0 is still reactivatable
+        assert rnet.lifecycle.previous is not None
+
+    def test_guard_lkg_ring_survives_restart(self, tmp_path):
+        from omldm_tpu.checkpoint import CheckpointManager
+
+        job = _job(None, guard=True)
+        job.config.checkpointing = True
+        job.config.checkpoint_dir = str(tmp_path)
+        from omldm_tpu.checkpoint import CheckpointManager as CM
+
+        job.checkpoint_manager = CM(str(tmp_path))
+        _feed(job, records=160, terminate=False)
+        guard = job.spokes[0].nets[0].pipeline.guard
+        ring = [r.copy() for r in guard._ring]
+        assert ring
+        path = job.checkpoint_manager.save(job)
+        restored = CheckpointManager(str(tmp_path)).restore(path=path)
+        rring = restored.spokes[0].nets[0].pipeline.guard._ring
+        assert len(rring) == len(ring)
+        for a, b in zip(ring, rring):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.slow
+    def test_kill_mid_ramp_converges_to_fault_free_decision(self, tmp_path):
+        """The ISSUE 11 kill-recovery pin: a worker crash mid-canary with
+        supervised restart resumes MID-RAMP (registry + clocks + candidate
+        state restored) and reaches the same promotion decision — and the
+        same final counters — as the fault-free run."""
+        gen = _events(2_000)
+
+        def run(fault):
+            job = StreamJob(JobConfig(
+                parallelism=1, batch_size=16, test_set_size=16,
+                checkpointing=bool(fault),
+                checkpoint_dir=str(tmp_path), check_interval_ms=0))
+            if fault:
+                FaultInjector().arm(job, 0, 700)
+                sup = JobSupervisor(job, replayable(gen), max_restarts=2)
+                report = sup.run()
+                assert sup.failures  # the crash really happened
+                return sup.job, report
+            return job, job.run(gen())
+
+        clean_job, clean_report = run(False)
+        fault_job, fault_report = run(True)
+        clean_lc = clean_job.spokes[0].nets[0].lifecycle.describe()
+        fault_lc = fault_job.spokes[0].nets[0].lifecycle.describe()
+        assert fault_lc["activeVersion"] == clean_lc["activeVersion"] == 1
+        assert fault_lc["counters"] == clean_lc["counters"]
+        [cs] = clean_report.statistics
+        [fs] = fault_report.statistics
+        assert fs.canary_promotions == cs.canary_promotions == 1
+        assert fs.canary_rollbacks == cs.canary_rollbacks == 0
+
+
+# --- observability / statistics plumbing -------------------------------------
+
+
+class TestObservability:
+    def test_prediction_version_tag_wire_format(self):
+        p = Prediction(0, None, 1.0)
+        assert "version" not in p.to_dict()  # pre-plane wire shape
+        p = Prediction(0, None, 1.0, version=3)
+        assert p.to_dict()["version"] == 3
+
+    def test_query_response_carries_registry_view(self):
+        job = _job(LC)
+        _shadow(job)
+        _feed(job, records=160, terminate=False)
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Query", "requestId": 1}))
+        [resp] = job.responses
+        assert resp.lifecycle is not None
+        assert resp.lifecycle["activeVersion"] == 0
+        assert resp.lifecycle["candidateVersion"] == 1
+        versions = {v["version"]: v for v in resp.lifecycle["versions"]}
+        assert versions[1]["state"] == SHADOW
+        assert versions[1]["shadowEvals"] > 0
+        # wire round trip
+        again = QueryResponse.from_dict(json.loads(resp.to_json()))
+        assert again.lifecycle["candidateVersion"] == 1
+        job.terminate()
+
+    def test_query_response_without_plane_keeps_wire_shape(self):
+        job = _job(None)
+        _feed(job, records=64, terminate=False)
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Query", "requestId": 1}))
+        [resp] = job.responses
+        assert resp.lifecycle is None
+        assert "lifecycle" not in resp.to_dict()
+        job.terminate()
+
+    def test_tenant_topology_lifecycle_section(self):
+        job = _job(LC, n_pipe=2)
+        _shadow(job, pid=1)
+        _feed(job, records=160, terminate=False)
+        topo = job.tenant_topology()
+        assert set(topo["lifecycle"]) == {0, 1}
+        assert topo["lifecycle"][1]["candidateVersion"] == 1
+        assert topo["lifecycle"][0]["candidateVersion"] is None
+        job.terminate()
+
+    def test_statistics_counters_merge_and_dict(self):
+        a, b = Statistics(0), Statistics(0)
+        a.update_stats(shadow_scored=2, canary_promotions=1,
+                       canary_rollbacks=0, active_version=1)
+        b.update_stats(shadow_scored=1, canary_rollbacks=2,
+                       active_version=3)
+        m = a.merge(b)
+        assert m.shadow_scored == 3
+        assert m.canary_promotions == 1
+        assert m.canary_rollbacks == 2
+        assert m.active_version == 3  # gauge: max-combine
+        d = m.to_dict()
+        assert (d["shadowScored"], d["canaryPromotions"],
+                d["canaryRollbacks"], d["activeVersion"]) == (3, 1, 2, 3)
+
+    def test_active_version_gauge_tracks_rollback_down(self):
+        """The gauge is last-write per fold: a Query mid-promotion folds
+        activeVersion=1, but an operator Rollback afterwards must bring
+        the FINAL report back to 0 — a max would pin the historical peak
+        and report a rolled-back version as live forever."""
+        job = _job(LC)
+        _shadow(job)
+        _promote(job)
+        _feed(job, records=320, terminate=False)
+        assert job.spokes[0].nets[0].lifecycle.active_version == 1
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Query", "requestId": 1}))
+        assert job.hub_manager.hubs[(0, 0)].node.stats.active_version == 1
+        _rollback(job)  # reactivate the retained version 0
+        report = job.terminate()
+        [stats] = report.statistics
+        assert stats.active_version == 0
+        assert stats.canary_rollbacks == 1
+
+    def test_counters_fold_once_per_query(self):
+        job = _job(LC)
+        _shadow(job)
+        _feed(job, records=160, terminate=False)
+        job.process_event(REQUEST_STREAM, json.dumps(
+            {"id": 0, "request": "Query", "requestId": 1}))
+        hub = job.hub_manager.hubs[(0, 0)]
+        folded = hub.node.stats.shadow_scored
+        assert folded > 0
+        report = job.terminate()
+        [stats] = report.statistics
+        # the terminate fold adds only the NEW evals since the query
+        assert stats.shadow_scored >= folded
+        lc = job.spokes[0].nets[0].lifecycle
+        assert stats.shadow_scored == lc.totals["shadow_scored"]
