@@ -850,6 +850,157 @@ def run_distributed_route(codecs, dim=256, steps=24, batch=32):
     return out
 
 
+# the autoscale-smoke operating point (ISSUE 12): a preloaded burst on
+# the file-backed Kafka broker, consumed by a SUPERVISED 1-process fleet
+# with pressure-driven autoscaling armed. The burst outpaces the
+# backlogCritical threshold every poll window, so the fleet sustains
+# CRITICAL, scales out to 2 processes (checkpoint -> relaunch ->
+# restore-with-rescale), drains, sustains OK, and scales back in to the
+# floor — two full elastic transitions inside one CI run.
+AUTOSCALE_ROWS = 8_000
+AUTOSCALE_FORE_EVERY = 20
+
+
+def run_autoscale_smoke() -> None:
+    """CI gate (ISSUE 12 acceptance): the supervised fleet must scale
+    out under a seeded sustained burst, lose ZERO records across the
+    restarts (every training row fitted or held out, every forecast
+    served exactly once — the EMITTED/output dedupe contract), and
+    return to the floor process count after the burst drains. NONZERO
+    EXIT otherwise."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    sys.path.insert(0, tests)
+    import fskafka
+
+    tmp = tempfile.mkdtemp(prefix="omldm-autoscale-smoke-")
+    broker = os.path.join(tmp, "broker")
+    os.environ["FSKAFKA_DIR"] = broker
+    n_fore = 0
+    try:
+        rng = np.random.RandomState(0)
+        w = rng.randn(12)
+        for i in range(AUTOSCALE_ROWS):
+            x = np.round(rng.randn(12), 6)
+            if i % AUTOSCALE_FORE_EVERY == 0:
+                n_fore += 1
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "operation": "forecasting",
+                })
+            else:
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "target": float(x @ w > 0),
+                    "operation": "training",
+                })
+            fskafka.append("trainingData", line, partition=i % 4)
+        fskafka.append("requests", json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "syncEvery": 1,
+            },
+        }))
+    finally:
+        os.environ.pop("FSKAFKA_DIR", None)
+
+    boot = (
+        "import sys; sys.path.insert(0, {t!r}); "
+        "import fskafka; fskafka.install(); "
+        "from omldm_tpu.runtime.distributed_job import run_distributed; "
+        "sys.exit(run_distributed(sys.argv[1:]))"
+    ).format(t=tests)
+    perf = os.path.join(tmp, "perf.jsonl")
+    preds = os.path.join(tmp, "preds.jsonl")
+    env = dict(os.environ)
+    # one CPU device per worker process; the parent's 8-device XLA flag
+    # must not leak into the fleet
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FSKAFKA_DIR"] = broker
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
+         "--supervise", "true", "--processes", "1",
+         "--autoscale", "true", "--minProcesses", "1",
+         "--maxProcesses", "2",
+         "--scaleUpAfterMs", "200", "--scaleDownAfterMs", "1200",
+         "--scaleCooldownMs", "400",
+         "--overload", "backlogHigh=40,backlogCritical=80",
+         "--kafkaBrokers", "fs://local", "--workerBoot", boot,
+         "--checkpointDir", os.path.join(tmp, "ckpts"),
+         "--checkpointEvery", "8",
+         "--chunkRows", "100", "--kafkaPollMs", "50",
+         "--idleWindows", "60",
+         "--batchSize", "64", "--testSetSize", "32",
+         "--restartAttempts", "2", "--restartDelayMs", "50",
+         "--performanceOut", perf, "--predictionsOut", preds],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    wall_s = time.perf_counter() - t0
+    err = out.stderr
+    failures = []
+    if out.returncode != 0:
+        failures.append(
+            f"supervised fleet exited {out.returncode}: {err[-2000:]}"
+        )
+    if "rescaling fleet 1 -> 2" not in err:
+        failures.append("the burst never drove a scale-OUT decision")
+    if "rescale-restore: redistributing a 1-process snapshot" not in err:
+        failures.append("scale-out relaunch did not restore-with-rescale")
+    if "rescaling fleet 2 -> 1" not in err:
+        failures.append(
+            "the fleet never scaled back IN after the burst drained"
+        )
+    report = {}
+    stats = {}
+    if not failures:
+        report = json.loads(open(perf).read().strip())
+        [stats] = report["statistics"]
+        n_train = AUTOSCALE_ROWS - n_fore
+        conserved = stats["fitted"] + report["holdout"]["0"]
+        if conserved != n_train:
+            failures.append(
+                f"records lost across the restarts: fitted+holdout "
+                f"{conserved} != {n_train} training rows"
+            )
+        payloads = [json.loads(l) for l in open(preds)]
+        if len(payloads) != n_fore:
+            failures.append(
+                f"forecasts not served exactly once: {len(payloads)} "
+                f"outputs for {n_fore} forecasts (output dedupe broken)"
+            )
+        if report.get("rescalesPerformed") != 2:
+            failures.append(
+                f"rescalesPerformed {report.get('rescalesPerformed')} != 2"
+            )
+        if report.get("fleetProcesses") != 1:
+            failures.append(
+                "fleet did not return to the floor process count "
+                f"(fleetProcesses {report.get('fleetProcesses')})"
+            )
+    print(json.dumps({
+        "config": "protocol_comparison_autoscale_smoke",
+        "rows": AUTOSCALE_ROWS,
+        "forecasts": n_fore,
+        "wall_s": round(wall_s, 1),
+        "rescales": report.get("rescalesPerformed"),
+        "fleet_processes": report.get("fleetProcesses"),
+        "fitted": stats.get("fitted"),
+        "score": stats.get("score"),
+        "failures": failures,
+    }))
+    if failures:
+        sys.exit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--records", type=int, default=50_000)
@@ -930,6 +1081,17 @@ def main() -> None:
              "no-lifecycle run; NONZERO EXIT otherwise",
     )
     ap.add_argument(
+        "--autoscale-smoke", action="store_true",
+        help="CI gate: pressure-driven elastic autoscaling end to end — "
+             "a preloaded burst on a (file-backed) Kafka broker must "
+             "drive the supervised 1-process fleet out to 2 processes "
+             "(checkpoint -> relaunch -> restore-with-rescale), healthy "
+             "tenants must lose ZERO records across the restarts and "
+             "serve every forecast exactly once, and the fleet must "
+             "scale back in to the floor once the burst drains; NONZERO "
+             "EXIT otherwise",
+    )
+    ap.add_argument(
         "--chaos-smoke", action="store_true",
         help="CI gate: short Synchronous + Asynchronous runs under seeded "
              "drop+dup+reorder chaos; NONZERO EXIT if a run crashes or "
@@ -945,6 +1107,13 @@ def main() -> None:
              "otherwise",
     )
     args = ap.parse_args()
+
+    if args.autoscale_smoke:
+        # subprocess-driven (the fleet runs in real worker processes):
+        # dispatch BEFORE the in-process jax/XLA setup below so the
+        # parent stays light and its 8-device flag never leaks
+        run_autoscale_smoke()
+        return
 
     import os
 

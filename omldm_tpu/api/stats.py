@@ -109,6 +109,17 @@ class Statistics:
     canary_promotions: int = 0
     canary_rollbacks: int = 0
     active_version: int = 0
+    # elastic-rescale telemetry (runtime/job.StreamJob.rescale and the
+    # distributed restore-with-rescale path, runtime/distributed_job):
+    # ``rescales_performed`` counts parallelism changes the pipeline's
+    # state has been carried across (live rescales in-process, restore-
+    # with-rescale relaunches in the supervised deployment — a JOB-level
+    # count mirrored into each pipeline's report like
+    # ``records_quarantined``); ``fleet_processes`` is a GAUGE carrying
+    # the CURRENT worker-process count of the distributed fleet (0 on the
+    # in-process runtime, whose parallelism already rides JobStatistics)
+    rescales_performed: int = 0
+    fleet_processes: int = 0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -138,6 +149,8 @@ class Statistics:
         canary_promotions: int = 0,
         canary_rollbacks: int = 0,
         active_version: Optional[int] = None,
+        rescales_performed: int = 0,
+        fleet_processes: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127).
         ``cohort_shards`` and ``pressure_level`` are gauges: max-combined,
@@ -168,6 +181,8 @@ class Statistics:
         self.canary_rollbacks += canary_rollbacks
         if active_version is not None:
             self.active_version = active_version
+        self.rescales_performed += rescales_performed
+        self.fleet_processes = max(self.fleet_processes, fleet_processes)
 
     def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
         """Fold one contributor's serving-latency percentile window in
@@ -245,6 +260,13 @@ class Statistics:
             + other.canary_promotions,
             canary_rollbacks=self.canary_rollbacks + other.canary_rollbacks,
             active_version=max(self.active_version, other.active_version),
+            # a job-level mirror (every contributor reports the same
+            # value): max-combine, not sum, so cross-hub merges do not
+            # multiply the count
+            rescales_performed=max(
+                self.rescales_performed, other.rescales_performed
+            ),
+            fleet_processes=max(self.fleet_processes, other.fleet_processes),
             serve_latency_p50_ms=max(
                 self.serve_latency_p50_ms, other.serve_latency_p50_ms
             ),
@@ -292,6 +314,8 @@ class Statistics:
             "canaryPromotions": self.canary_promotions,
             "canaryRollbacks": self.canary_rollbacks,
             "activeVersion": self.active_version,
+            "rescalesPerformed": self.rescales_performed,
+            "fleetProcesses": self.fleet_processes,
             "serveLatencyP50Ms": self.serve_latency_p50_ms,
             "serveLatencyP99Ms": self.serve_latency_p99_ms,
             "serveLatencyP999Ms": self.serve_latency_p999_ms,

@@ -192,6 +192,7 @@ class CheckpointManager:
             "offset": job.events_processed,
             "source_position": copy.deepcopy(job.source_position),
             "rr": job._rr,
+            "rescales": job.rescales_performed,
             "backlog": list(job._backlog._entries),
             "pending_creates": [r.to_dict() for r in job._pending_creates],
             "time": time.time(),
@@ -328,6 +329,12 @@ class CheckpointManager:
         job.events_processed = snapshot.get("offset", 0)
         job.source_position = snapshot.get("source_position")
         job._rr = snapshot.get("rr", 0)
+        job.rescales_performed = snapshot.get("rescales", 0)
+        saved_par = snapshot["config"].get("parallelism")
+        if parallelism is not None and parallelism != saved_par:
+            # a restore-with-rescale counts like a live rescale (the
+            # override redistributes every replica across the new count)
+            job.rescales_performed += 1
         for entry in snapshot.get("backlog", ()):
             job._backlog.append(entry)
         job._pending_creates = [

@@ -69,6 +69,112 @@ CONTROL_CAP = 1 << 16  # fixed broadcast buffer: 64 KiB of request lines
 CHUNK_ROWS = 4096
 
 
+# --- elastic rescale-restore helpers (pure, unit-tested) -------------------
+
+
+def rescale_shard_map(old_n: int, new_n: int, pid: int) -> List[int]:
+    """Old-process checkpoint shards owned by NEW process ``pid`` when an
+    ``old_n``-process snapshot restores across ``new_n`` processes: old
+    shard q merges into survivor ``q % new_n`` — the distributed twin of
+    the in-process shrink's ``id % n_new`` merge (StreamJob.rescale).
+    Under grow this degenerates to identity for ``pid < old_n`` and the
+    empty list for the seeded new processes; at ``old_n == new_n`` it is
+    exactly ``[pid]`` (the pre-rescale restore path)."""
+    return [q for q in range(old_n) if q % new_n == pid]
+
+
+def _interleave_perm(lengths: Sequence[int]) -> List[int]:
+    """Flat row indices that round-robin across blocks of the given
+    lengths (block rows are laid out back to back): [b0[0], b1[0], ...,
+    b0[1], b1[1], ...]. Merged per-process stripes stay a fair stream-
+    order mix — the holdout/pending interleave of the in-process
+    ``Spoke.absorb`` (SpokeLogic.scala:37-50 semantics)."""
+    offsets = np.cumsum([0] + list(lengths))
+    perm: List[int] = []
+    for j in range(max(lengths, default=0)):
+        for i, n in enumerate(lengths):
+            if j < n:
+                perm.append(int(offsets[i]) + j)
+    return perm
+
+
+def _interleave_rows(blocks: List[np.ndarray]) -> np.ndarray:
+    """Round-robin row interleave of [n_i, ...] arrays (see
+    :func:`_interleave_perm`)."""
+    cat = np.concatenate(blocks)
+    return cat[_interleave_perm([b.shape[0] for b in blocks])]
+
+
+def _rescale_fleet_leaf(full: np.ndarray, key: str, dp_new: int) -> np.ndarray:
+    """Redistribute one gathered fleet-state leaf (leading axis = the
+    global dp worker rows) across a NEW worker-row count:
+
+    - grow: new rows seed from the fleet model — a copy of worker row 0
+      (the replica queries/evals read), exactly the in-process grow's
+      seed-from-spoke-0; per-row accumulators that must not inflate the
+      fleet totals (EF residuals, cum_loss) seed at zero instead;
+    - shrink: retired row q merges into survivor ``q % dp_new`` — model
+      state (params/preps) merges by group MEAN (rows are fed round-robin
+      stripes, so equal weight is the faithful merge; the next protocol
+      round would average them anyway), fleet-total accumulators
+      (cum_loss) by group SUM, codec EF residuals reset (the model they
+      were computed against is gone — the reset_streams analogue), and
+      round-accounting counters (step/syncs/clock/accepted/est/...) keep
+      the SURVIVOR row's own values so every surviving worker stays on
+      the round schedule it checkpointed at."""
+    dp_old = full.shape[0]
+    if dp_new == dp_old:
+        return full
+    if dp_new > dp_old:
+        if key in ("ef", "cum_loss"):
+            extra = np.zeros((dp_new - dp_old,) + full.shape[1:], full.dtype)
+        else:
+            extra = np.repeat(full[:1], dp_new - dp_old, axis=0)
+        return np.concatenate([full, extra], axis=0)
+    if key in ("params", "preps"):
+        return np.stack(
+            [
+                full[w::dp_new].mean(axis=0).astype(full.dtype)
+                for w in range(dp_new)
+            ]
+        )
+    if key == "cum_loss":
+        return np.stack(
+            [
+                full[w::dp_new].sum(axis=0).astype(full.dtype)
+                for w in range(dp_new)
+            ]
+        )
+    if key == "ef":
+        return np.zeros((dp_new,) + full.shape[1:], full.dtype)
+    return full[:dp_new]
+
+
+def _merge_cursors(cursors: List[Any]) -> Any:
+    """One process's resume cursor from the per-process cursors of an
+    N-process snapshot. Kafka cursors (``{"data": {...}, "requests":
+    {...}}``) UNION across processes — the new partition stripe scatters
+    old assignments across every new process, so each one needs the full
+    per-partition offset map (max wins where a stale superset entry
+    collides with the owner's newer value). File cursors (row ints /
+    ``{"bytes", "lines"}`` dicts) are fleet-global and identical at a
+    synchronized pump point, so the first shard speaks for everyone."""
+    cursors = [c for c in cursors if c is not None]
+    if not cursors:
+        return None
+    head = cursors[0]
+    if isinstance(head, dict) and "data" in head:
+        data: Dict[str, int] = {}
+        requests: Dict[str, int] = {}
+        for c in cursors:
+            for k, v in (c.get("data") or {}).items():
+                data[k] = max(int(v), data.get(k, 0))
+            for k, v in (c.get("requests") or {}).items():
+                requests[k] = max(int(v), requests.get(k, 0))
+        return {"data": data, "requests": requests}
+    return head
+
+
 def _mesh_and_procs(coordinator, num_processes, process_id):
     """Join the process group (if any) and build the global dp mesh."""
     import jax
@@ -206,6 +312,23 @@ class DistributedStreamJob:
         self.overload_cfg = parse_overload_spec(
             getattr(config, "overload", "") or ""
         )
+        # pressure PEAK since the last heartbeat tick: the drive loops pump
+        # (drain) right before each tick, so the instantaneous level at
+        # tick time would always read OK — the peak over the window is the
+        # honest signal the autoscaling supervisor consumes (updated by
+        # the row-buffering paths, zero-cost unarmed)
+        self._level_window = 0
+        # elastic rescale-restore (restore-with-rescale): a snapshot taken
+        # with N processes may restore across M != N (fleet rows merged/
+        # seeded, shards remapped, source stripe re-agreed). Disabled via
+        # --rescaleRestore false, which degrades a count mismatch to a
+        # warned fresh start instead of crashing the fleet attempt.
+        self.rescale_restore = True
+        # cumulative rescale count for Statistics: pinned by the
+        # supervisor (--rescaleCount, authoritative across incarnations);
+        # an unsupervised manual rescale-restore self-increments instead
+        self.rescales_performed = 0
+        self._rescale_count_pinned = False
         self._ckpt_seq = 0
         self._reduce_jits: Dict[Tuple[str, int], Any] = {}
         self._loss_mean_jit = None
@@ -236,6 +359,23 @@ class DistributedStreamJob:
         if backlog >= cfg.backlog_high:
             return 1
         return 0
+
+    def _note_pressure(self) -> None:
+        """Track the pressure peak across a pump window (called by the
+        row-buffering paths — the moment the staging backlog is honest,
+        before pump drains it). One attribute write when unarmed-free."""
+        if self.overload_cfg is not None:
+            level = self.overload_level()
+            if level > self._level_window:
+                self._level_window = level
+
+    def overload_level_window(self) -> int:
+        """The worst pressure level since the last call (folded with the
+        instantaneous level), then reset — the per-tick value the
+        heartbeat file carries to the autoscaling supervisor."""
+        level = max(self._level_window, self.overload_level())
+        self._level_window = 0
+        return level
 
     def _fetch_replicated(self, arr) -> np.ndarray:
         """Host copy of a REPLICATED global array: read the local shard
@@ -480,6 +620,7 @@ class DistributedStreamJob:
             return
         for p in self.pipelines.values():
             self._buffer_rows(p, x, y)
+        self._note_pressure()
 
     def _buffer_rows(self, p: _DistPipeline, x: np.ndarray, y: np.ndarray) -> None:
         if self.config.test:
@@ -513,6 +654,7 @@ class DistributedStreamJob:
             return
         for p in self.pipelines.values():
             self._buffer_rows_sparse(p, idx, val, y)
+        self._note_pressure()
 
     def _buffer_rows_sparse(self, p, idx, val, y) -> None:
         if self.config.test:
@@ -550,6 +692,7 @@ class DistributedStreamJob:
             p.fore_x.append(np.asarray(idx, np.int32))
             p.fore_v.append(np.asarray(val, np.float32))
             p.fore_n += idx.shape[0]
+        self._note_pressure()
 
     def handle_forecast_rows(self, x: np.ndarray) -> None:
         """Buffer forecast rows from this partition for every pipeline;
@@ -561,6 +704,7 @@ class DistributedStreamJob:
         for p in self.pipelines.values():
             p.fore_x.append(np.asarray(x, np.float32))
             p.fore_n += x.shape[0]
+        self._note_pressure()
 
     def pump(self, final: bool = False) -> None:
         """Run the agreed number of lockstep collective steps per pipeline
@@ -1060,6 +1204,10 @@ class DistributedStreamJob:
             lcx=[r for _, r in p.curve],
             mean_buffer_size=float(reduced[2]) / self.nproc,
             score=score,
+            # elastic-rescale telemetry: how many parallelism changes this
+            # state has been carried across, and the CURRENT fleet width
+            rescales_performed=self.rescales_performed,
+            fleet_processes=self.nproc,
         )
         return stats, int(round(reduced[1]))
 
@@ -1088,6 +1236,10 @@ class DistributedStreamJob:
             statistics=entries,
         ).to_dict()
         report["processes"] = self.nproc
+        # deployment-level mirrors of the per-pipeline gauges (operators
+        # read the job header without walking statistics rows)
+        report["fleetProcesses"] = self.nproc
+        report["rescalesPerformed"] = self.rescales_performed
         report["holdout"] = holdout
         # LOCAL count (process 0's workers): >0 proves the SSP requeue
         # path executed in this run
@@ -1267,11 +1419,16 @@ class DistributedStreamJob:
 
     def _validate_checkpoint(self, d: str) -> Optional[dict]:
         """Fully load-check every file THIS process needs from snapshot
-        ``d`` (manifest, its own proc shard pair, the fleet files);
-        returns the manifest, or None — with the reason logged — when any
-        file is missing, truncated, or undecodable. Loading every array
-        is deliberate: a torn npz can open fine and fail only when its
-        members decompress, and restore must never half-load."""
+        ``d`` (manifest, the proc shard pairs the rescale shard map hands
+        it, every process's cursor meta, the fleet files); returns the
+        manifest, or None — with the reason logged — when any file is
+        missing, truncated, or undecodable. Loading every array is
+        deliberate: a torn npz can open fine and fail only when its
+        members decompress, and restore must never half-load. A snapshot
+        from a DIFFERENT process count validates the shards this process
+        will merge (``rescale_shard_map``) — unless rescale-restore is
+        disabled, in which case only the manifest is checked (restore
+        refuses with the actionable knob before touching any shard)."""
         try:
             with open(os.path.join(d, "manifest.json")) as f:
                 manifest = json.load(f)
@@ -1279,9 +1436,18 @@ class DistributedStreamJob:
                 int(json.loads(line)["id"])
                 for line in manifest["request_lines"]
             ]
-            with open(os.path.join(d, f"proc{self.pid}.json")) as f:
-                json.load(f)
-            paths = [os.path.join(d, f"proc{self.pid}.npz")] + [
+            old_n = int(manifest.get("processes", self.nproc))
+            if old_n != self.nproc and not self.rescale_restore:
+                return manifest
+            # cursor metas of EVERY old process (the Kafka offset union
+            # needs them all; cheap JSON reads)
+            for q in range(old_n):
+                with open(os.path.join(d, f"proc{q}.json")) as f:
+                    json.load(f)
+            paths = [
+                os.path.join(d, f"proc{q}.npz")
+                for q in rescale_shard_map(old_n, self.nproc, self.pid)
+            ] + [
                 os.path.join(d, f"fleet_{net_id}.npz") for net_id in net_ids
             ]
             for path in paths:
@@ -1380,10 +1546,29 @@ class DistributedStreamJob:
                     latest, os.path.basename(d).encode()
                 )
             self.barrier()  # nobody proceeds past a half-pruned root
-        if manifest["processes"] != self.nproc:
-            raise ValueError(
-                f"snapshot taken with {manifest['processes']} processes; "
-                f"restore requires the same count (got {self.nproc})"
+        old_n = int(manifest["processes"])
+        if old_n != self.nproc:
+            if not self.rescale_restore:
+                # reason-coded refusal, not a fleet crash: the operator
+                # pinned the strict count contract, so degrade to the
+                # fresh-start path (the caller redeploys the requests
+                # file) and name the knob that re-enables elasticity
+                self._warn(
+                    f"snapshot {os.path.basename(d)} was taken with "
+                    f"{old_n} processes but this fleet has {self.nproc}, "
+                    "and rescale-restore is disabled (--rescaleRestore "
+                    "false) — starting fresh. Relaunch with "
+                    "--rescaleRestore true (the default) to redistribute "
+                    "the snapshot across the new process count."
+                )
+                return None
+            if not self._rescale_count_pinned:
+                self.rescales_performed += 1
+            self._warn(
+                f"rescale-restore: redistributing a {old_n}-process "
+                f"snapshot across {self.nproc} processes "
+                f"(fleet rows {int(manifest['dp_global'])} -> "
+                f"{self.dp_global}; source stripe re-agreed)"
             )
         self._ckpt_seq = int(manifest["seq"]) + 1
         # redeploy the pipeline map from the recorded request lines (no
@@ -1403,62 +1588,140 @@ class DistributedStreamJob:
 
         from omldm_tpu.parallel.multihost import host_local_array
 
-        with open(os.path.join(d, f"proc{self.pid}.json")) as f:
-            meta = json.load(f)
+        # shards this process merges (exactly [pid] when the count is
+        # unchanged; the retiring shards' union on shrink; empty for a
+        # grow-seeded new process) + every process's cursor meta (the
+        # Kafka offset union needs them all)
+        shards = rescale_shard_map(old_n, self.nproc, self.pid)
+        all_metas: List[dict] = []
+        for q in range(old_n):
+            with open(os.path.join(d, f"proc{q}.json")) as f:
+                all_metas.append(json.load(f))
+        metas = [all_metas[q] for q in shards]
         self.orphan_predictions = [
             (int(n), float(v))
-            for n, v in meta.get("orphan_predictions", [])
+            for m in metas
+            for n, v in m.get("orphan_predictions", [])
         ]
         if self.pid == 0:
+            # responses live on old process 0's meta; shard 0 always maps
+            # to new process 0 (0 % M == 0)
             self.responses.extend(
-                QueryResponse.from_dict(r) for r in meta.get("responses", [])
+                QueryResponse.from_dict(r)
+                for r in all_metas[0].get("responses", [])
             )
-        arrays = np.load(os.path.join(d, f"proc{self.pid}.npz"))
+        shard_arrays = [
+            np.load(os.path.join(d, f"proc{q}.npz")) for q in shards
+        ]
         lo = self.pid * self.dp_local
         for net_id in sorted(self.pipelines):
             p = self.pipelines[net_id]
             fleet = np.load(os.path.join(d, f"fleet_{net_id}.npz"))
-            flat_state, treedef = jax.tree_util.tree_flatten(p.trainer.state)
+            # leaf index -> top-level state key (params/preps/ef/...) so
+            # the rescale redistribution can apply per-leaf merge rules;
+            # tree_flatten_with_path walks the same order tree_leaves
+            # walked at save time
+            paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+                p.trainer.state
+            )
             placed = []
-            for i in range(len(flat_state)):
-                full = fleet[f"leaf_{i}"]
+            for i, (path, _) in enumerate(paths_leaves):
+                key = str(getattr(path[0], "key", path[0]))
+                full = _rescale_fleet_leaf(
+                    fleet[f"leaf_{i}"], key, self.dp_global
+                )
                 local = full[lo : lo + self.dp_local]
                 placed.append(
                     host_local_array(local, self.mesh, P("dp", "hub"))
                 )
             p.trainer.state = jax.tree_util.tree_unflatten(treedef, placed)
-            pm = meta["pipelines"][str(net_id)]
-            p.holdout_count = int(pm["holdout_count"])
-            p.trainer._fitted_host = int(pm["fitted"])
-            p.trainer._steps_host = int(pm["steps_host"])
-            p.trainer.requeued_rows = int(pm["requeued"])
-            p.steps_run = int(pm["steps_run"])
-            p.predictions = list(pm["predictions"])
-            p.curve = [(float(l), int(r)) for l, r in pm["curve"]]
-            p.global_rows = int(pm["global_rows"])
-            px = arrays[f"n{net_id}_pend_x"]
-            if px.shape[0]:
-                p.pend_x = [px]
-                if p.sparse:
-                    p.pend_v = [arrays[f"n{net_id}_pend_v"]]
-                p.pend_y = [arrays[f"n{net_id}_pend_y"]]
-                p.pend_n = int(px.shape[0])
-            fx = arrays[f"n{net_id}_fore_x"]
-            if fx.shape[0]:
-                p.fore_x = [fx]
-                if p.sparse:
-                    p.fore_v = [arrays[f"n{net_id}_fore_v"]]
-                p.fore_n = int(fx.shape[0])
-            tx = arrays[f"n{net_id}_test_x"]
-            if tx.shape[0]:
-                if p.sparse:
-                    p.test_set.append_many(
-                        tx, arrays[f"n{net_id}_test_v"],
-                        arrays[f"n{net_id}_test_y"],
-                    )
-                else:
-                    p.test_set.append_many(tx, arrays[f"n{net_id}_test_y"])
-        return meta["cursor"]
+            pms = [m["pipelines"][str(net_id)] for m in metas]
+            # additive per-partition counters SUM across merged shards;
+            # lockstep counters (collective step counts) are identical on
+            # every process at a synchronized cut, so max == any
+            p.holdout_count = sum(int(pm["holdout_count"]) for pm in pms)
+            p.trainer._fitted_host = sum(int(pm["fitted"]) for pm in pms)
+            p.trainer._steps_host = max(
+                (int(pm["steps_host"]) for pm in pms), default=0
+            )
+            p.trainer.requeued_rows = sum(int(pm["requeued"]) for pm in pms)
+            p.steps_run = max((int(pm["steps_run"]) for pm in pms), default=0)
+            p.predictions = [float(v) for pm in pms for v in pm["predictions"]]
+            # the learning curve is fleet-global (collectively reduced at
+            # save time): the first merged shard speaks for everyone, and
+            # a grow-seeded process adopts old process 0's copy
+            curve_src = pms[0] if pms else all_metas[0]["pipelines"].get(
+                str(net_id), {"curve": [], "global_rows": 0}
+            )
+            p.curve = [(float(l), int(r)) for l, r in curve_src["curve"]]
+            p.global_rows = int(curve_src["global_rows"])
+            if shard_arrays:
+                self._restore_buffers(p, net_id, shard_arrays)
+        return _merge_cursors([m["cursor"] for m in all_metas])
+
+    def _restore_buffers(
+        self, p: _DistPipeline, net_id: int, shard_arrays: List[Any]
+    ) -> None:
+        """Merge the staged pending/forecast/holdout buffers of every
+        checkpoint shard this process owns (one shard on a same-count
+        restore; the retiring stripes' union on shrink — rows interleave
+        round-robin so the merged buffers stay a fair stream-order mix,
+        the in-process absorb's holdout-interleave semantics)."""
+        pend = [a[f"n{net_id}_pend_x"] for a in shard_arrays]
+        if sum(b.shape[0] for b in pend):
+            perm = _interleave_perm([b.shape[0] for b in pend])
+            p.pend_x = [np.concatenate(pend)[perm]]
+            if p.sparse:
+                p.pend_v = [
+                    np.concatenate(
+                        [a[f"n{net_id}_pend_v"] for a in shard_arrays]
+                    )[perm]
+                ]
+            p.pend_y = [
+                np.concatenate(
+                    [a[f"n{net_id}_pend_y"] for a in shard_arrays]
+                )[perm]
+            ]
+            p.pend_n = int(p.pend_x[0].shape[0])
+        fore = [a[f"n{net_id}_fore_x"] for a in shard_arrays]
+        if sum(b.shape[0] for b in fore):
+            perm = _interleave_perm([b.shape[0] for b in fore])
+            p.fore_x = [np.concatenate(fore)[perm]]
+            if p.sparse:
+                p.fore_v = [
+                    np.concatenate(
+                        [a[f"n{net_id}_fore_v"] for a in shard_arrays]
+                    )[perm]
+                ]
+            p.fore_n = int(p.fore_x[0].shape[0])
+        test = [a[f"n{net_id}_test_x"] for a in shard_arrays]
+        if sum(b.shape[0] for b in test):
+            perm = _interleave_perm([b.shape[0] for b in test])
+            tx = np.concatenate(test)[perm]
+            ty = np.concatenate(
+                [a[f"n{net_id}_test_y"] for a in shard_arrays]
+            )[perm]
+            # merged holdouts can overflow the ring (shrink folds several
+            # full rings into one): evicted rows RE-FEED the training
+            # buffer, exactly what the live holdout split does with its
+            # evictions (_buffer_rows) — rows conserve across a rescale,
+            # none vanish with the retired partitions
+            if p.sparse:
+                tv = np.concatenate(
+                    [a[f"n{net_id}_test_v"] for a in shard_arrays]
+                )[perm]
+                ev_i, ev_v, ev_y, ev_src = p.test_set.append_many(tx, tv, ty)
+                if ev_src.size:
+                    p.pend_x.append(np.asarray(ev_i, np.int32))
+                    p.pend_v.append(np.asarray(ev_v, np.float32))
+                    p.pend_y.append(np.asarray(ev_y, np.float32))
+                    p.pend_n += int(ev_src.size)
+            else:
+                ev_x, ev_y, ev_src = p.test_set.append_many(tx, ty)
+                if ev_src.size:
+                    p.pend_x.append(np.asarray(ev_x, np.float32))
+                    p.pend_y.append(np.asarray(ev_y, np.float32))
+                    p.pend_n += int(ev_src.size)
 
 
 # --- drive loops -----------------------------------------------------------
@@ -1510,19 +1773,71 @@ def _flag_true(flags: Dict[str, str], key: str) -> bool:
     return flags.get(key, "").lower() in ("true", "1", "yes")
 
 
-def _heartbeat(flags: Dict[str, str], pid: int) -> None:
+def _heartbeat(flags: Dict[str, str], pid: int, level: int = 0) -> None:
     """Touch this process's heartbeat file (the supervisor's liveness
     channel). Called at every synchronized pump point, so a process wedged
-    in a collective (peer died) stops beating and gets detected."""
+    in a collective (peer died) stops beating and gets detected. The file
+    body carries ``<epoch> <pressure-level>`` — the second token is the
+    window-peak overload level the autoscaling supervisor folds across
+    the fleet (absent/zero when the overload plane is unarmed)."""
     d = flags.get("heartbeatDir")
     if not d:
         return
     try:
         os.makedirs(d, exist_ok=True)
-        with open(os.path.join(d, f"proc{pid}.hb"), "w") as f:
-            f.write(str(time.time()))
+        # atomic replace: the supervisor polls this file between writes,
+        # and a torn read of a truncate-in-progress beat would feed the
+        # autoscaler a phantom level-0 sample mid-burst
+        path = os.path.join(d, f"proc{pid}.hb")
+        with open(path + ".tmp", "w") as f:
+            f.write(f"{time.time()} {int(level)}")
+        os.replace(path + ".tmp", path)
     except OSError:
         pass  # a full/odd disk must not kill the job over telemetry
+
+
+def _maybe_rescale_exit(
+    job: DistributedStreamJob, flags: Dict[str, str], cursor: Any
+) -> None:
+    """Honor a standing rescale signal from the autoscaling supervisor:
+    process 0 reads the target process count from the signal file, the
+    fleet AGREES on it over the fabric (file visibility can race between
+    processes — an unagreed exit would wedge the survivors in their next
+    collective), snapshots the consistent cut, and every process exits
+    with the rescale code so the supervisor relaunches at the new count
+    with ``--restore``. No signal dir armed (the default) => zero cost,
+    no extra collectives."""
+    sig_dir = flags.get("rescaleSignalDir")
+    if not sig_dir:
+        return
+    target = 0
+    if job.pid == 0:
+        try:
+            with open(os.path.join(sig_dir, "RESCALE")) as f:
+                target = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            target = 0
+    agreed = int(job._collective_reduce([float(target)], "max")[0])
+    if agreed <= 0 or agreed == job.nproc:
+        return
+    root = flags.get("checkpointDir")
+    if not root:
+        # without a checkpoint dir the relaunch would lose all state;
+        # refuse loudly (the supervisor refuses to arm autoscale without
+        # one, so this is a manually-miswired fleet)
+        job._warn(
+            "rescale signal ignored: no --checkpointDir to carry state "
+            "across the relaunch"
+        )
+        return
+    d = job.save_checkpoint(root, cursor)
+    job._warn(
+        f"rescale signal honored: snapshot {os.path.basename(d)} taken, "
+        f"fleet exiting to relaunch at {agreed} processes"
+    )
+    from omldm_tpu.runtime.supervisor import RESCALE_EXIT
+
+    raise SystemExit(RESCALE_EXIT)
 
 
 def _make_injector(job: DistributedStreamJob, flags: Dict[str, str]):
@@ -1564,7 +1879,7 @@ def _chunk_tick(
     crashes fire here too, so a kill lands at one well-defined cut (the
     supervisor then relaunches the fleet with --restore, Flink's
     global-restart strategy)."""
-    _heartbeat(flags, job.pid)
+    _heartbeat(flags, job.pid, job.overload_level_window())
     every = int(flags.get("checkpointEvery", "0"))
     root = flags.get("checkpointDir")
     if every > 0 and root and (chunk_idx + 1) % every == 0:
@@ -1572,6 +1887,9 @@ def _chunk_tick(
         injector.on_checkpoint(d)
     injector.note_records(records)
     injector.on_chunk(chunk_idx)
+    # autoscaling: a supervisor-issued rescale signal checkpoints this
+    # consistent cut and exits the fleet for a relaunch at the new count
+    _maybe_rescale_exit(job, flags, cursor)
 
 
 def _sparse_tools(job: DistributedStreamJob):
@@ -2152,6 +2470,9 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         job_name=flags.get("jobName", "OMLDM"),
         batch_size=int(flags.get("batchSize", "256")),
         test_set_size=int(flags.get("testSetSize", "64")),
+        # the distributed engine's backpressure/pressure signal
+        # (runtime/overload.py backlog thresholds); unset = unarmed
+        overload=flags.get("overload", ""),
     )
     nproc_flag = int(flags.get("processes", "0"))
     # --processes 1 with no coordinator is a plain single-process run;
@@ -2163,6 +2484,15 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         num_processes=nproc_flag if use_group else None,
         process_id=int(flags["processId"]) if use_group else None,
     )
+    # elastic-rescale knobs: --rescaleRestore false pins the strict
+    # same-count restore contract; --rescaleCount is the supervisor's
+    # authoritative cumulative rescale tally for Statistics
+    job.rescale_restore = flags.get(
+        "rescaleRestore", "true"
+    ).lower() not in ("false", "0", "no")
+    if "rescaleCount" in flags:
+        job.rescales_performed = int(flags["rescaleCount"] or 0)
+        job._rescale_count_pinned = True
     # process 0 reads the request file; everyone else receives the
     # broadcast (passing lines from a non-0 process is ignored). On a
     # restore the manifest redeploys the pipeline map instead — the

@@ -118,28 +118,16 @@ class StreamJob:
                 self._reply_to_spoke, spec, "down", name="hub>spoke"
             )
             self._burst = BurstInjector.from_spec(spec)
-        send_to_hub = (
-            self._chaos_up.send if self._chaos_up is not None
-            else self.hub_manager.route
-        )
         self.spokes: List[Spoke] = [
-            Spoke(
-                worker_id=i,
-                config=self.config,
-                send_to_hub=send_to_hub,
-                emit_prediction=self._emit_prediction,
-                emit_response=self._route_response_fragment,
-                on_poll=self.stats.mark_activity,
-                note_wire=self._note_wire,
-                emit_predictions=self._emit_predictions,
-                quarantine=self.dead_letter.quarantine,
-                tenant_routing=self._burst is not None,
-            )
-            for i in range(self.config.parallelism)
+            self._spawn_spoke(i) for i in range(self.config.parallelism)
         ]
         # in-memory mirror trim counters (see _trim_emission)
         self.predictions_trimmed = 0
         self.responses_trimmed = 0
+        # live parallelism changes this job's state has been carried
+        # across (rescale(); mirrored into every pipeline's Statistics at
+        # terminate — the in-process half of the rescalesPerformed counter)
+        self.rescales_performed = 0
         self._rr = 0  # round-robin data partitioner (the reference rebalances)
         self._pending_creates: List[Request] = []  # awaiting dim inference
         self._dims: dict = {}  # network_id -> feature dim
@@ -176,6 +164,32 @@ class StreamJob:
                 self.config.checkpoint_dir,
                 keep=getattr(self.config, "checkpoint_keep", 3),
             )
+
+    def _spawn_spoke(self, worker_id: int) -> Spoke:
+        """The ONE spoke recipe — construction at job init and spokes
+        added by a live :meth:`rescale` grow share it, so every opt-in
+        wiring decision (chaos routing, tenant-addressed record routing,
+        quarantine, telemetry callbacks) is derived from the same rule on
+        both paths. Tenant routing in particular: the job-level flag is
+        armed by the burst injector; an armed overload controller arms
+        the route per spoke at deploy time (Spoke._create), which a
+        rescaled-in spoke re-runs when the live pipelines re-deploy."""
+        send_to_hub = (
+            self._chaos_up.send if self._chaos_up is not None
+            else self.hub_manager.route
+        )
+        return Spoke(
+            worker_id=worker_id,
+            config=self.config,
+            send_to_hub=send_to_hub,
+            emit_prediction=self._emit_prediction,
+            emit_response=self._route_response_fragment,
+            on_poll=self.stats.mark_activity,
+            note_wire=self._note_wire,
+            emit_predictions=self._emit_predictions,
+            quarantine=self.dead_letter.quarantine,
+            tenant_routing=self._burst is not None,
+        )
 
     # --- sinks ---
 
@@ -542,26 +556,10 @@ class StreamJob:
             return
         if n_new < 1:
             raise ValueError(f"parallelism must be >= 1, got {n_new}")
+        self.rescales_performed += 1
         if n_new > p:
-            send_to_hub = (
-                self._chaos_up.send if self._chaos_up is not None
-                else self.hub_manager.route
-            )
             for w in range(p, n_new):
-                self.spokes.append(
-                    Spoke(
-                        worker_id=w,
-                        config=self.config,
-                        send_to_hub=send_to_hub,
-                        emit_prediction=self._emit_prediction,
-                        emit_response=self._route_response_fragment,
-                        on_poll=self.stats.mark_activity,
-                        note_wire=self._note_wire,
-                        emit_predictions=self._emit_predictions,
-                        quarantine=self.dead_letter.quarantine,
-                        tenant_routing=self._burst is not None,
-                    )
-                )
+                self.spokes.append(self._spawn_spoke(w))
             self.config.parallelism = n_new
             # deploy live host-plane pipelines on the new workers
             for net_id, request in self.pipeline_manager.node_map.items():
@@ -609,6 +607,53 @@ class StreamJob:
                     # rollback must never land on the stale init params
                     if dst.pipeline.guard is not None:
                         dst.pipeline.guard.reseed(dst.pipeline)
+                    # model-lifecycle replication: a live registry with a
+                    # candidate (or a promoted active version) replicates
+                    # onto the grown spoke through the checkpoint-restore
+                    # recipe — otherwise the new spoke would twin-train
+                    # nothing and a stream whose training rows happen to
+                    # round-robin onto it would stall the canary forever
+                    if (
+                        src.lifecycle is not None
+                        and dst.lifecycle is not None
+                        and (
+                            src.lifecycle.candidate is not None
+                            or src.lifecycle.active_version != 0
+                        )
+                    ):
+                        from omldm_tpu.checkpoint.checkpoint import (
+                            _pipeline_snapshot,
+                        )
+
+                        fresh_fitted = dst.pipeline.state["fitted"]
+                        fresh_loss = dst.pipeline.state["cum_loss"]
+                        swapped = dst.lifecycle.restore(
+                            dst,
+                            src.lifecycle.snapshot(),
+                            _pipeline_snapshot(src.pipeline),
+                        )
+                        # the replica's own statistics start fresh: the
+                        # source spoke keeps its un-folded counter deltas
+                        # (replicating them would double-count at the
+                        # query/terminate fold)
+                        for k in dst.lifecycle._pending:
+                            dst.lifecycle._pending[k] = 0
+                            dst.lifecycle.totals[k] = 0
+                        if swapped:
+                            # restore installed the PROMOTED-spec pipeline
+                            # carrying src's full state: re-apply the
+                            # fresh-replica seeding contract to the new
+                            # pipeline object (own counters zero, drift
+                            # baseline / codec streams / guard ring
+                            # re-anchored at the seeded model)
+                            state = dst.pipeline.state
+                            state["fitted"] = fresh_fitted
+                            state["cum_loss"] = fresh_loss
+                            dst.node.on_model_seeded()
+                            if dst.node.codec is not None:
+                                dst.node.codec.reset_streams()
+                            if dst.pipeline.guard is not None:
+                                dst.pipeline.guard.reseed(dst.pipeline)
         else:
             survivors, retired = self.spokes[:n_new], self.spokes[n_new:]
             self.config.parallelism = n_new
@@ -901,11 +946,15 @@ class StreamJob:
         # (a dropped record would have reached each of them; see the
         # Statistics.records_quarantined field note)
         nq = self.dead_letter.record_count
+        nr = self.rescales_performed
         for bridge in self.spmd_bridges.values():
             bridge.handle_terminate_probe()
             bridge_stats = bridge.network_statistics()
-            if nq and bridge_stats is not None:
-                bridge_stats.update_stats(records_quarantined=nq)
+            if bridge_stats is not None:
+                if nq:
+                    bridge_stats.update_stats(records_quarantined=nq)
+                if nr:
+                    bridge_stats.update_stats(rescales_performed=nr)
             self.stats.add_hub_statistics(bridge.request.id, bridge_stats)
         self.hub_manager.on_terminate()
         for net_id in self.pipeline_manager.live_pipelines:
@@ -913,6 +962,11 @@ class StreamJob:
             if merged is not None:
                 if nq:
                     merged.update_stats(records_quarantined=nq)
+                if nr:
+                    # like records_quarantined: a JOB-level count mirrored
+                    # into each pipeline's report (rescales touch every
+                    # live pipeline's replicas)
+                    merged.update_stats(rescales_performed=nr)
                 merged.normalize(
                     max(
                         len(

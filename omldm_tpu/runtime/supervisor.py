@@ -73,7 +73,22 @@ SUPERVISOR_ONLY_FLAGS = {
     "heartbeatTimeoutMs",
     "workerBoot",
     "supervisorDir",
+    # pressure-driven autoscaling (AutoscalePolicy knobs)
+    "autoscale",
+    "minProcesses",
+    "maxProcesses",
+    "scaleFactor",
+    "scaleUpAfterMs",
+    "scaleDownAfterMs",
+    "scaleCooldownMs",
+    "maxRescales",
 }
+
+# exit code a worker fleet uses to signal "checkpointed and exiting for a
+# supervised relaunch at a new process count" (distributed_job's
+# _maybe_rescale_exit) — distinct from failure codes so the restart
+# policy does not burn an attempt on a planned rescale
+RESCALE_EXIT = 17
 
 
 class FleetFailure(RuntimeError):
@@ -105,6 +120,117 @@ def _free_port() -> int:
     return port
 
 
+@dataclasses.dataclass
+class RescaleRecord:
+    """One pressure-driven fleet rescale (the supervisor's scaling log)."""
+
+    from_procs: int
+    to_procs: int
+    level: int  # folded fleet pressure level that drove the decision
+    at: float
+
+
+class _FleetRescaled(RuntimeError):
+    """Internal control flow: the fleet checkpointed and exited with
+    RESCALE_EXIT; relaunch at ``target`` processes (not a failure)."""
+
+    def __init__(self, target: int, level: int):
+        super().__init__(f"fleet rescaling to {target} processes")
+        self.target = target
+        self.level = level
+
+
+class AutoscalePolicy:
+    """Pure pressure -> target-process-count policy (injectable clock, no
+    I/O — unit-testable without fleets).
+
+    The input is the FOLDED fleet pressure level each supervisor poll
+    (max over worker heartbeats: 0 OK / 1 ELEVATED / 2 CRITICAL, the
+    overload plane's ladder). Sustained CRITICAL for ``up_after_s``
+    scales out by ``scale_factor`` (bounded by ``max_processes``);
+    sustained OK for ``down_after_s`` scales back in (floored at
+    ``min_processes``). ELEVATED holds steady — the worker-local
+    degradation ladder owns that band. ``cooldown_s`` after each rescale
+    gives the relaunched fleet time to drain the backlog it inherited
+    before the next decision; sustain streaks reset across rescales and
+    restarts (a fresh incarnation's pressure must re-prove itself)."""
+
+    def __init__(
+        self,
+        *,
+        min_processes: int = 1,
+        max_processes: int = 8,
+        scale_factor: int = 2,
+        up_after_s: float = 1.0,
+        down_after_s: float = 5.0,
+        cooldown_s: float = 2.0,
+    ):
+        if min_processes < 1:
+            raise ValueError(f"minProcesses must be >= 1, got {min_processes}")
+        if max_processes < min_processes:
+            raise ValueError(
+                f"maxProcesses {max_processes} < minProcesses {min_processes}"
+            )
+        if scale_factor < 2:
+            raise ValueError(f"scaleFactor must be >= 2, got {scale_factor}")
+        self.min_processes = min_processes
+        self.max_processes = max_processes
+        self.scale_factor = scale_factor
+        self.up_after_s = up_after_s
+        self.down_after_s = down_after_s
+        self.cooldown_s = cooldown_s
+        self._crit_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        self._last_rescale: Optional[float] = None
+
+    def reset(self) -> None:
+        """Forget sustain streaks (fleet (re)launch: fresh evidence)."""
+        self._crit_since = None
+        self._calm_since = None
+
+    def note_rescaled(self, now: float) -> None:
+        self._last_rescale = now
+        self.reset()
+
+    def decide(self, nproc: int, level: int, now: float) -> Optional[int]:
+        """The target process count to rescale to, or None (hold).
+        ``level < 0`` means UNKNOWN (no pressure evidence yet — e.g. a
+        fleet still compiling): both streaks clear and nothing fires."""
+        if level < 0:
+            self._crit_since = None
+            self._calm_since = None
+            return None
+        if level >= 2:
+            self._calm_since = None
+            if self._crit_since is None:
+                self._crit_since = now
+        elif level <= 0:
+            self._crit_since = None
+            if self._calm_since is None:
+                self._calm_since = now
+        else:
+            self._crit_since = None
+            self._calm_since = None
+        if (
+            self._last_rescale is not None
+            and now - self._last_rescale < self.cooldown_s
+        ):
+            return None
+        if (
+            self._crit_since is not None
+            and now - self._crit_since >= self.up_after_s
+            and nproc < self.max_processes
+        ):
+            return min(nproc * self.scale_factor, self.max_processes)
+        if (
+            self._calm_since is not None
+            and now - self._calm_since >= self.down_after_s
+            and nproc > self.min_processes
+        ):
+            return max(nproc // self.scale_factor, self.min_processes)
+        return None
+
+
 class DistributedJobSupervisor:
     """Run the N-process distributed job under a fixed-delay restart policy.
 
@@ -130,6 +256,23 @@ class DistributedJobSupervisor:
     worker blocked in a fabric collective whose peer died may never exit
     on its own). The clock for a worker starts at its spawn, so slow
     first-compile startups need a timeout above their compile time.
+
+    Autoscaling: with an :class:`AutoscalePolicy` the supervisor also
+    FOLDS the fleet's pressure level (each worker's heartbeat file
+    carries its window-peak overload level) every poll. A sustained-
+    CRITICAL decision writes the target count into the ``RESCALE``
+    signal file; the workers agree on it over their own fabric at the
+    next synchronized pump point, snapshot the consistent cut, and exit
+    with :data:`RESCALE_EXIT` — the supervisor then relaunches at the
+    new ``--processes`` with ``--restore`` (restore-with-rescale
+    redistributes the snapshot), WITHOUT consuming a restart attempt.
+    Sustained OK scales back in the same way. Requires a
+    ``--checkpointDir`` in ``worker_args`` (state must survive the
+    relaunch); decisions are logged and recorded in ``self.rescales``,
+    and the cumulative count reaches worker Statistics via
+    ``--rescaleCount``. A stale-but-present beat can pin the last
+    reported level until the heartbeat timeout fires — arm
+    ``heartbeat_timeout_s`` alongside autoscale in production.
     """
 
     def __init__(
@@ -146,6 +289,8 @@ class DistributedJobSupervisor:
         cwd: Optional[str] = None,
         run_dir: Optional[str] = None,
         poll_interval_s: float = 0.05,
+        autoscale: Optional[AutoscalePolicy] = None,
+        max_rescales: int = 32,
     ):
         if num_processes < 1:
             raise ValueError(f"num_processes must be >= 1, got {num_processes}")
@@ -166,6 +311,16 @@ class DistributedJobSupervisor:
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="omldm-supervise-")
         self.hb_dir = os.path.join(self.run_dir, "heartbeats")
         self.failures: List[AttemptRecord] = []
+        self.autoscale = autoscale
+        self.max_rescales = max_rescales
+        self.rescales: List[RescaleRecord] = []
+        if autoscale is not None and not self._checkpoint_root():
+            # a rescale relaunch without a checkpoint would lose all
+            # state; refuse loudly at construction, not mid-burst
+            raise ValueError(
+                "autoscale requires --checkpointDir in the worker args "
+                "(rescale relaunches restore from the latest snapshot)"
+            )
 
     def _log(self, msg: str) -> None:
         print(f"[supervisor] {msg}", file=sys.stderr, flush=True)
@@ -179,9 +334,39 @@ class DistributedJobSupervisor:
             args += ["--coordinator", f"127.0.0.1:{port}"]
         if restore:
             args += ["--restore", "true"]
-        if self.heartbeat_timeout_s > 0:
+        if self._beats_armed():
             args += ["--heartbeatDir", self.hb_dir]
+        if self.autoscale is not None:
+            args += [
+                "--rescaleSignalDir", self.run_dir,
+                "--rescaleCount", str(len(self.rescales)),
+            ]
         return args
+
+    def _beats_armed(self) -> bool:
+        # the heartbeat files double as the pressure channel, so the
+        # autoscaler arms them even without a liveness timeout
+        return self.heartbeat_timeout_s > 0 or self.autoscale is not None
+
+    def _checkpoint_root(self) -> Optional[str]:
+        root = None
+        for i, arg in enumerate(self.worker_args):
+            if arg == "--checkpointDir" and i + 1 < len(self.worker_args):
+                root = self.worker_args[i + 1]
+        return root
+
+    def _signal_path(self) -> str:
+        return os.path.join(self.run_dir, "RESCALE")
+
+    def _read_signal(self) -> int:
+        """Target count in the standing signal file (0 = none/garbled) —
+        the fallback when a fleet honors a signal written by an earlier
+        incarnation of the attempt loop."""
+        try:
+            with open(self._signal_path()) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
 
     def _beat_age(self, pid: int, spawned_at: float, now: float) -> float:
         # wall-clock throughout: beat files only expose epoch mtimes
@@ -191,6 +376,33 @@ class DistributedJobSupervisor:
             )
         except OSError:
             return now - spawned_at  # no beat yet: clock runs from spawn
+
+    def _beat_level(self, pid: int) -> Optional[int]:
+        """This worker's last-reported pressure level (heartbeat body
+        token 2). None when the worker has not beaten yet (startup /
+        compile); 0 for a legacy-format or garbled beat."""
+        try:
+            with open(os.path.join(self.hb_dir, f"proc{pid}.hb")) as f:
+                parts = f.read().split()
+        except OSError:
+            return None
+        try:
+            return int(float(parts[1])) if len(parts) > 1 else 0
+        except (ValueError, IndexError):
+            return 0
+
+    def fleet_pressure(self) -> int:
+        """The folded fleet pressure level: max over every worker's
+        heartbeat-reported window peak (the supervisor-side twin of
+        StreamJob.overload_level's fold over spokes). Returns -1 while NO
+        worker has beaten yet — a compiling fleet must read as unknown,
+        not calm, or the scale-in streak would start during startup."""
+        levels = [
+            lvl
+            for lvl in (self._beat_level(pid) for pid in range(self.nproc))
+            if lvl is not None
+        ]
+        return max(levels) if levels else -1
 
     def _kill_fleet(self, procs: List[subprocess.Popen]) -> None:
         for p in procs:
@@ -211,11 +423,19 @@ class DistributedJobSupervisor:
                 p.wait()
 
     def _run_attempt(self, restore: bool) -> None:
-        """Spawn the fleet and block until success (all exit 0) or a
-        detected failure (raises :class:`FleetFailure`)."""
-        if self.heartbeat_timeout_s > 0:
+        """Spawn the fleet and block until success (all exit 0), a
+        detected failure (raises :class:`FleetFailure`), or — with
+        autoscaling armed — an agreed rescale exit (raises
+        :class:`_FleetRescaled` once every worker has exited with
+        :data:`RESCALE_EXIT`)."""
+        if self._beats_armed():
             shutil.rmtree(self.hb_dir, ignore_errors=True)
             os.makedirs(self.hb_dir, exist_ok=True)
+        if self.autoscale is not None:
+            self.autoscale.reset()
+        ok_codes = (0,) if self.autoscale is None else (0, RESCALE_EXIT)
+        pending_target = 0  # a written-but-not-yet-honored rescale signal
+        decision_level = 0
         port = _free_port()
         spawned_at = time.time()
         procs = [
@@ -229,7 +449,11 @@ class DistributedJobSupervisor:
         try:
             while True:
                 codes = [p.poll() for p in procs]
-                bad = [i for i, rc in enumerate(codes) if rc not in (None, 0)]
+                bad = [
+                    i
+                    for i, rc in enumerate(codes)
+                    if rc is not None and rc not in ok_codes
+                ]
                 if bad:
                     raise FleetFailure(
                         "process "
@@ -239,6 +463,17 @@ class DistributedJobSupervisor:
                     )
                 if all(rc == 0 for rc in codes):
                     return
+                if (
+                    self.autoscale is not None
+                    and all(rc is not None for rc in codes)
+                    and any(rc == RESCALE_EXIT for rc in codes)
+                ):
+                    # the fleet checkpointed the agreed cut and exited to
+                    # be relaunched at the signaled count
+                    raise _FleetRescaled(
+                        pending_target or self._read_signal() or self.nproc,
+                        decision_level,
+                    )
                 if self.heartbeat_timeout_s > 0:
                     now = time.time()
                     stale = [
@@ -255,6 +490,20 @@ class DistributedJobSupervisor:
                             returncode=1,
                             failed=stale,
                         )
+                if self.autoscale is not None and not pending_target:
+                    level = self.fleet_pressure()
+                    target = self.autoscale.decide(
+                        self.nproc, level, time.monotonic()
+                    )
+                    if target is not None and target != self.nproc:
+                        pending_target, decision_level = target, level
+                        with open(self._signal_path(), "w") as f:
+                            f.write(str(target))
+                        self._log(
+                            f"fleet pressure level {level} sustained: "
+                            f"signaling rescale {self.nproc} -> {target} "
+                            "processes"
+                        )
                 time.sleep(self.poll_interval_s)
         finally:
             self._kill_fleet(procs)
@@ -262,31 +511,67 @@ class DistributedJobSupervisor:
     # --- the restart policy ------------------------------------------------
 
     def _checkpoint_exists(self) -> bool:
-        root = None
-        for i, arg in enumerate(self.worker_args):
-            if arg == "--checkpointDir" and i + 1 < len(self.worker_args):
-                root = self.worker_args[i + 1]
+        root = self._checkpoint_root()
         return bool(root) and os.path.exists(os.path.join(root, "LATEST"))
+
+    def _apply_rescale(self, rescaled: "_FleetRescaled") -> None:
+        """Commit a pressure-driven rescale: clear the signal, record the
+        decision, move the fleet width, start the cooldown clock."""
+        if len(self.rescales) >= self.max_rescales:
+            raise FleetFailure(
+                f"autoscale rescale budget exhausted "
+                f"({self.max_rescales} rescales)",
+                returncode=1,
+                failed=[],
+            )
+        try:
+            os.unlink(self._signal_path())
+        except OSError:
+            pass
+        self.rescales.append(
+            RescaleRecord(
+                from_procs=self.nproc,
+                to_procs=rescaled.target,
+                level=rescaled.level,
+                at=time.time(),
+            )
+        )
+        self._log(
+            f"rescaling fleet {self.nproc} -> {rescaled.target} processes "
+            f"(pressure-driven; rescale {len(self.rescales)})"
+        )
+        self.nproc = rescaled.target
+        if self.autoscale is not None:
+            self.autoscale.note_rescaled(time.monotonic())
 
     def run(self) -> int:
         """Supervise to completion. Returns 0 on success; raises the last
-        :class:`FleetFailure` once ``max_restarts`` is exhausted."""
+        :class:`FleetFailure` once ``max_restarts`` is exhausted.
+        Pressure-driven rescales relaunch WITHOUT consuming a restart
+        attempt (they are planned transitions, bounded by
+        ``max_rescales``, not failures)."""
         state = {"first": True}
 
         def attempt() -> int:
             restore = not state["first"]
             state["first"] = False
-            if restore:
-                self._log(
-                    "relaunching fleet"
-                    + (
-                        " from latest consistent checkpoint"
-                        if self._checkpoint_exists()
-                        else " fresh (no checkpoint taken before the failure)"
+            while True:
+                if restore:
+                    self._log(
+                        "relaunching fleet"
+                        + (
+                            " from latest consistent checkpoint"
+                            if self._checkpoint_exists()
+                            else
+                            " fresh (no checkpoint taken before the failure)"
+                        )
                     )
-                )
-            self._run_attempt(restore=restore)
-            return 0
+                try:
+                    self._run_attempt(restore=restore)
+                    return 0
+                except _FleetRescaled as rescaled:
+                    self._apply_rescale(rescaled)
+                    restore = True
 
         def on_retry(exc: Exception, next_attempt: int) -> None:
             record = AttemptRecord(
@@ -355,6 +640,22 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
         # bootstrap code for the worker interpreters (tests install the
         # file-backed kafka fake before production imports resolve)
         worker_cmd = [sys.executable, "-c", flags["workerBoot"]]
+    autoscale = None
+    if flags.get("autoscale", "").lower() in ("true", "1", "yes", "on"):
+        if not flags.get("checkpointDir"):
+            raise SystemExit(
+                "--autoscale requires --checkpointDir (rescale relaunches "
+                "restore the fleet from the latest snapshot)"
+            )
+        autoscale = AutoscalePolicy(
+            min_processes=int(flags.get("minProcesses", "1")),
+            max_processes=int(flags.get("maxProcesses", "8")),
+            scale_factor=int(flags.get("scaleFactor", "2")),
+            up_after_s=float(flags.get("scaleUpAfterMs", "1000")) / 1000.0,
+            down_after_s=float(flags.get("scaleDownAfterMs", "5000"))
+            / 1000.0,
+            cooldown_s=float(flags.get("scaleCooldownMs", "2000")) / 1000.0,
+        )
     sup = DistributedJobSupervisor(
         worker_args,
         nproc,
@@ -365,6 +666,8 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
         / 1000.0,
         worker_cmd=worker_cmd,
         run_dir=flags.get("supervisorDir"),
+        autoscale=autoscale,
+        max_rescales=int(flags.get("maxRescales", "32")),
     )
     try:
         return sup.run()
@@ -1010,6 +1313,9 @@ def maybe_chaos_consumer(
 
 __all__ = [
     "AttemptRecord",
+    "AutoscalePolicy",
+    "RESCALE_EXIT",
+    "RescaleRecord",
     "BurstInjector",
     "ChaosChannel",
     "ChaosConsumer",

@@ -1,0 +1,336 @@
+"""Pressure-driven autoscaling (ISSUE 12 tentpole, supervisor half).
+
+Pins:
+
+- AutoscalePolicy semantics: sustained CRITICAL scales out (factor,
+  bounded by maxProcesses), sustained OK scales in (floored at
+  minProcesses), ELEVATED holds, unknown pressure (compiling fleet)
+  holds and clears streaks, cooldown gates consecutive decisions,
+  validation rejects nonsense bounds;
+- the supervisor plumbing: autoscale arms the heartbeat/pressure
+  channel and the signal/count flags, refuses to arm without a
+  checkpoint dir, folds beat-file pressure levels (missing beats read
+  UNKNOWN, not calm);
+- the worker side: a standing rescale signal checkpoints the consistent
+  cut and exits with RESCALE_EXIT; a same-count or absent signal is a
+  no-op; a signal without a checkpoint dir warns and keeps running;
+- (slow) the full loop: a preloaded burst drives a supervised 1-process
+  fleet out to 2 processes and back in to the floor, with exact row
+  conservation and every forecast served exactly once.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime.distributed_job import (
+    DistributedStreamJob,
+    _maybe_rescale_exit,
+)
+from omldm_tpu.runtime.supervisor import (
+    RESCALE_EXIT,
+    AutoscalePolicy,
+    DistributedJobSupervisor,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+DIM = 6
+
+FSKAFKA_BOOT = (
+    "import sys; sys.path.insert(0, {tests!r}); "
+    "import fskafka; fskafka.install(); "
+    "from omldm_tpu.runtime.distributed_job import run_distributed; "
+    "sys.exit(run_distributed(sys.argv[1:]))"
+).format(tests=TESTS)
+
+
+# --- policy units ------------------------------------------------------------
+
+
+def _policy(**kw):
+    kw.setdefault("min_processes", 1)
+    kw.setdefault("max_processes", 8)
+    kw.setdefault("up_after_s", 1.0)
+    kw.setdefault("down_after_s", 2.0)
+    kw.setdefault("cooldown_s", 0.5)
+    return AutoscalePolicy(**kw)
+
+
+class TestAutoscalePolicy:
+    def test_sustained_critical_scales_out(self):
+        p = _policy()
+        assert p.decide(2, 2, 0.0) is None  # streak starts
+        assert p.decide(2, 2, 0.5) is None  # not sustained yet
+        assert p.decide(2, 2, 1.0) == 4     # doubled
+
+    def test_bounded_by_max(self):
+        p = _policy(max_processes=3)
+        p.decide(2, 2, 0.0)
+        assert p.decide(2, 2, 1.5) == 3
+        p2 = _policy(max_processes=2)
+        p2.decide(2, 2, 0.0)
+        assert p2.decide(2, 2, 1.5) is None  # already at the ceiling
+
+    def test_sustained_ok_scales_in(self):
+        p = _policy()
+        p.decide(4, 0, 0.0)
+        assert p.decide(4, 0, 1.0) is None
+        assert p.decide(4, 0, 2.0) == 2
+
+    def test_floored_by_min(self):
+        p = _policy(min_processes=3)
+        p.decide(4, 0, 0.0)
+        assert p.decide(4, 0, 2.5) == 3
+        p2 = _policy(min_processes=1)
+        p2.decide(1, 0, 0.0)
+        assert p2.decide(1, 0, 99.0) is None  # at the floor
+
+    def test_elevated_holds_and_clears_streaks(self):
+        p = _policy()
+        p.decide(2, 2, 0.0)
+        assert p.decide(2, 1, 0.9) is None   # ELEVATED clears critical streak
+        assert p.decide(2, 2, 1.0) is None   # streak restarted
+        assert p.decide(2, 2, 2.0) == 4
+
+    def test_unknown_pressure_holds(self):
+        p = _policy()
+        p.decide(2, 0, 0.0)
+        assert p.decide(2, -1, 1.0) is None  # compiling fleet: no evidence
+        assert p.decide(2, 0, 2.5) is None   # calm streak restarted at 2.5
+        assert p.decide(2, 0, 4.5) == 1
+
+    def test_level_flap_never_fires(self):
+        p = _policy()
+        for i in range(40):
+            assert p.decide(2, 2 if i % 2 else 0, i * 0.3) is None
+
+    def test_cooldown_gates_consecutive_decisions(self):
+        p = _policy(cooldown_s=10.0)
+        p.decide(1, 2, 0.0)
+        assert p.decide(1, 2, 1.0) == 2
+        p.note_rescaled(1.0)
+        p.decide(2, 2, 2.0)
+        assert p.decide(2, 2, 9.0) is None   # sustained but cooling down
+        assert p.decide(2, 2, 11.5) == 4
+
+    def test_reset_forgets_streaks(self):
+        p = _policy()
+        p.decide(1, 2, 0.0)
+        p.reset()
+        assert p.decide(1, 2, 1.5) is None   # streak must re-prove itself
+
+    @pytest.mark.parametrize("kw", [
+        {"min_processes": 0},
+        {"min_processes": 4, "max_processes": 2},
+        {"scale_factor": 1},
+    ])
+    def test_validation(self, kw):
+        with pytest.raises(ValueError):
+            _policy(**kw)
+
+
+# --- supervisor plumbing -----------------------------------------------------
+
+
+class TestSupervisorWiring:
+    def test_autoscale_requires_checkpoint_dir(self, tmp_path):
+        with pytest.raises(ValueError, match="checkpointDir"):
+            DistributedJobSupervisor(
+                ["--trainingData", "x.jsonl"], 1, autoscale=_policy(),
+                run_dir=str(tmp_path),
+            )
+
+    def test_supervise_flags_reject_autoscale_without_ckpt(self):
+        from omldm_tpu.runtime.supervisor import supervise_from_flags
+
+        with pytest.raises(SystemExit, match="checkpointDir"):
+            supervise_from_flags({"autoscale": "true", "processes": "1"})
+
+    def _sup(self, tmp_path, **kw):
+        return DistributedJobSupervisor(
+            ["--checkpointDir", str(tmp_path / "ck")], 2,
+            run_dir=str(tmp_path / "run"), **kw,
+        )
+
+    def test_worker_argv_arms_pressure_channel(self, tmp_path):
+        sup = self._sup(tmp_path, autoscale=_policy())
+        argv = sup._worker_argv(0, 9999, restore=False)
+        assert "--heartbeatDir" in argv
+        assert "--rescaleSignalDir" in argv
+        assert argv[argv.index("--rescaleCount") + 1] == "0"
+
+    def test_worker_argv_unarmed_without_autoscale(self, tmp_path):
+        sup = self._sup(tmp_path)
+        argv = sup._worker_argv(0, 9999, restore=False)
+        assert "--rescaleSignalDir" not in argv
+        assert "--heartbeatDir" not in argv
+
+    def test_fleet_pressure_folds_beats(self, tmp_path):
+        sup = self._sup(tmp_path, autoscale=_policy())
+        os.makedirs(sup.hb_dir)
+        assert sup.fleet_pressure() == -1  # nobody has beaten: unknown
+        with open(os.path.join(sup.hb_dir, "proc0.hb"), "w") as f:
+            f.write("123.0 0")
+        assert sup.fleet_pressure() == 0
+        with open(os.path.join(sup.hb_dir, "proc1.hb"), "w") as f:
+            f.write("123.0 2")
+        assert sup.fleet_pressure() == 2
+        # legacy single-token beats read level 0, not a crash
+        with open(os.path.join(sup.hb_dir, "proc1.hb"), "w") as f:
+            f.write("123.0")
+        assert sup.fleet_pressure() == 0
+
+
+# --- worker-side rescale signal ----------------------------------------------
+
+
+CREATE = json.dumps({
+    "id": 0, "request": "Create",
+    "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": DIM}},
+    "preProcessors": [],
+    "trainingConfiguration": {"protocol": "Synchronous", "syncEvery": 1},
+})
+
+
+def _worker_job():
+    job = DistributedStreamJob(JobConfig(batch_size=8, test_set_size=16))
+    job.sync_requests([CREATE])
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, DIM).astype(np.float32)
+    job.handle_partition_rows(x, (x[:, 0] > 0).astype(np.float32))
+    job.pump()
+    return job
+
+
+class TestRescaleSignalExit:
+    def test_signal_checkpoints_and_exits(self, tmp_path):
+        job = _worker_job()
+        sig = tmp_path / "run"
+        sig.mkdir()
+        (sig / "RESCALE").write_text("2")
+        flags = {"rescaleSignalDir": str(sig),
+                 "checkpointDir": str(tmp_path / "ck")}
+        with pytest.raises(SystemExit) as exc:
+            _maybe_rescale_exit(job, flags, 64)
+        assert exc.value.code == RESCALE_EXIT
+        assert (tmp_path / "ck" / "LATEST").exists()
+
+    def test_same_count_signal_noop(self, tmp_path):
+        job = _worker_job()
+        sig = tmp_path / "run"
+        sig.mkdir()
+        (sig / "RESCALE").write_text("1")  # == current nproc
+        _maybe_rescale_exit(
+            job, {"rescaleSignalDir": str(sig),
+                  "checkpointDir": str(tmp_path / "ck")}, 64,
+        )  # no exit
+
+    def test_absent_signal_noop(self, tmp_path):
+        job = _worker_job()
+        _maybe_rescale_exit(
+            job, {"rescaleSignalDir": str(tmp_path),
+                  "checkpointDir": str(tmp_path / "ck")}, 64,
+        )
+        _maybe_rescale_exit(job, {}, 64)  # unarmed: zero-cost
+
+    def test_signal_without_ckpt_dir_warns_keeps_running(
+        self, tmp_path, capsys
+    ):
+        job = _worker_job()
+        sig = tmp_path / "run"
+        sig.mkdir()
+        (sig / "RESCALE").write_text("2")
+        _maybe_rescale_exit(job, {"rescaleSignalDir": str(sig)}, 64)
+        assert "rescale signal ignored" in capsys.readouterr().err
+
+
+# --- the full loop (slow) ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_supervised_autoscale_out_and_back(tmp_path):
+    """A preloaded burst drives the supervised fleet 1 -> 2 processes;
+    once drained, sustained OK brings it back to the floor; the final
+    report conserves every training row and serves every forecast
+    exactly once across both restore-with-rescale relaunches."""
+    sys.path.insert(0, TESTS)
+    import fskafka
+
+    broker = tmp_path / "broker"
+    os.environ["FSKAFKA_DIR"] = str(broker)
+    try:
+        rng = np.random.RandomState(0)
+        w = rng.randn(12)
+        n_rows, n_fore = 8000, 0
+        for i in range(n_rows):
+            x = np.round(rng.randn(12), 6)
+            if i % 20 == 0:
+                n_fore += 1
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "operation": "forecasting",
+                })
+            else:
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "target": float(x @ w > 0), "operation": "training",
+                })
+            fskafka.append("trainingData", line, partition=i % 4)
+        fskafka.append("requests", json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "syncEvery": 1,
+            },
+        }))
+    finally:
+        os.environ.pop("FSKAFKA_DIR", None)
+
+    perf = tmp_path / "perf.jsonl"
+    preds = tmp_path / "preds.jsonl"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FSKAFKA_DIR"] = str(broker)
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
+         "--supervise", "true", "--processes", "1",
+         "--autoscale", "true", "--minProcesses", "1",
+         "--maxProcesses", "2",
+         "--scaleUpAfterMs", "200", "--scaleDownAfterMs", "1200",
+         "--scaleCooldownMs", "400",
+         "--overload", "backlogHigh=40,backlogCritical=80",
+         "--kafkaBrokers", "fs://local", "--workerBoot", FSKAFKA_BOOT,
+         "--checkpointDir", str(tmp_path / "ckpts"),
+         "--checkpointEvery", "8",
+         "--chunkRows", "100", "--kafkaPollMs", "50",
+         "--idleWindows", "60",
+         "--batchSize", "64", "--testSetSize", "32",
+         "--restartAttempts", "2", "--restartDelayMs", "50",
+         "--performanceOut", str(perf), "--predictionsOut", str(preds)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    err = out.stderr
+    assert "signaling rescale 1 -> 2" in err
+    assert "rescaling fleet 1 -> 2" in err
+    assert "rescale-restore: redistributing a 1-process snapshot" in err
+    assert "rescaling fleet 2 -> 1" in err
+    report = json.loads(perf.read_text().strip())
+    [s] = report["statistics"]
+    assert s["fitted"] + report["holdout"]["0"] == n_rows - n_fore
+    assert report["rescalesPerformed"] == 2
+    assert report["fleetProcesses"] == 1  # back at the floor
+    assert s["rescalesPerformed"] == 2 and s["fleetProcesses"] == 1
+    payloads = [json.loads(l) for l in preds.read_text().splitlines()]
+    assert len(payloads) == n_fore  # exactly once across the relaunches

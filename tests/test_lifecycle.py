@@ -997,3 +997,106 @@ class TestObservability:
         assert stats.shadow_scored >= folded
         lc = job.spokes[0].nets[0].lifecycle
         assert stats.shadow_scored == lc.totals["shadow_scored"]
+
+
+# --- live rescale composed with the lifecycle plane (ISSUE 12 satellite) -----
+
+
+class TestRescaleComposition:
+    """Live ``rescale()`` mid-canary must keep the registry/clock state
+    consistent: the candidate survives on the surviving spoke, healthy
+    co-tenant forecast counts stay exactly equal to a canary-free run of
+    the same stream + rescale, and the rescaled fleet keeps serving and
+    promoting without a crash."""
+
+    def _run(self, rescale_to, par=1, canary=True, records_pre=160,
+             records_post=160, post_cycle=2):
+        """``post_cycle=2`` keeps the module's alternating stream shape;
+        3 breaks the train/forecast <-> round-robin parity lock (at par 2
+        an alternating stream pins ALL forecasts to one spoke and ALL
+        training rows to the other — a degenerate split real streams
+        don't sustain) so per-spoke promotion conditions can complete."""
+        job = _job(LC if canary else None, n_pipe=2, parallelism=par)
+        if canary:
+            _shadow(job)
+            _promote(job)
+        _feed(job, records=records_pre, terminate=False)
+        job.rescale(rescale_to)
+        # continue the SAME stream past the rescale point
+        rng = np.random.RandomState(3)
+        w = np.random.RandomState(5).randn(DIM)
+        for _ in range(records_pre):
+            rng.randn(DIM)  # replay the consumed prefix of the stream
+        for i in range(records_post):
+            f = rng.randn(DIM).astype(np.float32)
+            if (records_pre + i) % post_cycle == 0:
+                job.process_event(FORECASTING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist()}))
+            else:
+                job.process_event(TRAINING_STREAM, json.dumps(
+                    {"numericalFeatures": f.tolist(),
+                     "target": float(f @ w > 0)}))
+        report = job.terminate()
+        return job, report
+
+    def test_grow_mid_canary_consistent(self):
+        job, report = self._run(rescale_to=2)
+        by = {s.pipeline: s for s in report.statistics}
+        # zero forecast loss on every tenant across the grow
+        assert by[0].forecasts_served == 160
+        assert by[1].forecasts_served == 160
+        assert by[0].rescales_performed == 1
+        # the candidate lives on (worker-0 registry is the representative
+        # view; rescaled-in spokes serve 100% baseline)
+        lc = job.spokes[0].nets[0].lifecycle
+        assert lc.candidate is not None or lc.totals["canary_promotions"] >= 1
+        # no phantom rollback from the rescale itself
+        assert by[0].canary_rollbacks == 0
+        # registry view still coherent through the topology report
+        topo = job.tenant_topology()
+        assert 0 in topo["lifecycle"]
+
+    def test_grow_mid_canary_healthy_tenant_unchanged(self):
+        """Healthy-tenant (net 1) forecast count under a mid-canary grow
+        equals the canary-free run of the identical stream + rescale."""
+        _, with_canary = self._run(rescale_to=2, canary=True)
+        _, without = self._run(rescale_to=2, canary=False)
+        served = lambda r, p: {  # noqa: E731
+            s.pipeline: s.forecasts_served for s in r.statistics
+        }[p]
+        assert served(with_canary, 1) == served(without, 1)
+
+    def test_shrink_mid_canary_candidate_survives(self):
+        job, report = self._run(rescale_to=1, par=2)
+        by = {s.pipeline: s for s in report.statistics}
+        assert by[0].forecasts_served == 160
+        assert by[1].forecasts_served == 160
+        # the SURVIVING spoke's candidate is intact; the retired
+        # replica's registry row released silently (no rollback count)
+        lc = job.spokes[0].nets[0].lifecycle
+        assert lc.candidate is not None or lc.totals["canary_promotions"] >= 1
+        assert by[0].canary_rollbacks == 0
+        assert by[0].rescales_performed == 1
+
+    def test_shrink_mid_canary_healthy_tenant_unchanged(self):
+        _, with_canary = self._run(rescale_to=1, par=2, canary=True)
+        _, without = self._run(rescale_to=1, par=2, canary=False)
+        served = lambda r, p: {  # noqa: E731
+            s.pipeline: s.forecasts_served for s in r.statistics
+        }[p]
+        assert served(with_canary, 1) == served(without, 1)
+
+    def test_grow_then_promote_completes(self):
+        """The canary keeps training AND ramping after a grow — the
+        replicated registry twin-trains on the new spoke too, so with
+        enough post-rescale stream the ramp completes and the candidate
+        promotes on the spokes that host it."""
+        job, report = self._run(rescale_to=2, records_pre=64,
+                                records_post=420, post_cycle=3)
+        [s0] = [s for s in report.statistics if s.pipeline == 0]
+        assert s0.canary_promotions >= 1
+        # both spokes' replicated registries kept twin-training
+        for spoke in job.spokes:
+            assert spoke.nets[0].lifecycle.describe()["versions"][-1][
+                "fits"
+            ] > 1

@@ -748,3 +748,71 @@ class TestStatsPlumbing:
         for s in report.statistics:
             assert (s.forecasts_shed, s.records_throttled,
                     s.pressure_level, s.shed_latency_ms) == (0, 0, 0, 0.0)
+
+
+# --- tenant routing on rescaled-in spokes (ISSUE 12 satellite) ---------------
+
+
+class TestRescaledSpokeTenantRouting:
+    """Spokes added by a live ``rescale()`` grow are built by the SAME
+    factory as the originals (StreamJob._spawn_spoke), so every opt-in
+    rule — the burst injector's job-level tenant_routing flag and the
+    per-deploy overload-controller arming — holds identically on them.
+    Regression pins: a tenant-addressed record landing on a rescaled-in
+    spoke routes (armed) or broadcasts (unarmed) exactly like one landing
+    on an original spoke."""
+
+    def _tenant_record(self, tenant=1):
+        return json.dumps({
+            "numericalFeatures": [0.0] * DIM,
+            "metadata": {"tenant": tenant},
+        })
+
+    def test_armed_controller_routes_on_grown_spoke(self):
+        job = _job(OVR, n_pipe=3)
+        job.rescale(2)
+        grown = job.spokes[1]
+        assert grown.overload is not None  # re-armed at re-deploy
+        # two records: round-robin lands the second on the grown spoke
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        report = job.terminate()
+        by = {s.pipeline: s.forecasts_served for s in report.statistics}
+        # BOTH routed to tenant 1 alone — no broadcast fan-out leak on
+        # the rescaled-in spoke
+        assert by == {0: 0, 1: 2, 2: 0}
+
+    def test_job_level_flag_survives_grow(self):
+        """With the burst injector armed (job-level tenant_routing) and
+        NO overload controller, grown spokes still route."""
+        job = _job(None, n_pipe=3, chaos=BURST)
+        assert job._burst is not None
+        job.rescale(2)
+        assert job.spokes[1].tenant_routing is True
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        report = job.terminate()
+        by = {s.pipeline: s.forecasts_served for s in report.statistics}
+        assert by == {0: 0, 1: 2, 2: 0}
+
+    def test_unarmed_grown_spoke_keeps_broadcast(self):
+        """Neither plane armed: a tenant key on a record landing on a
+        rescaled-in spoke still BROADCASTS (the bit-identity invariant
+        of the unarmed route)."""
+        job = _job(None, n_pipe=3)
+        job.rescale(2)
+        assert job.spokes[1].tenant_routing is False
+        assert job.spokes[1].overload is None
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        job.process_event(FORECASTING_STREAM, self._tenant_record())
+        report = job.terminate()
+        for s in report.statistics:
+            assert s.forecasts_served == 2  # full fan-out, both records
+
+    def test_rescale_counter_reported(self):
+        job = _job(None, n_pipe=2)
+        job.rescale(3)
+        job.rescale(1)
+        report = _feed_records(job, records=32)
+        for s in report.statistics:
+            assert s.rescales_performed == 2
