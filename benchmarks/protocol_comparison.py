@@ -86,7 +86,7 @@ def _codec_seconds(job) -> float:
 
 def run_one(protocol: str, x, y, parallelism: int, batch: int,
             engine: str = "host", codec: str = "none", chaos: str = "",
-            sync_every: int = 4, guard: bool = False):
+            sync_every: int = 4, guard: bool = False, telemetry: str = ""):
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -97,7 +97,7 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
     job = StreamJob(
         JobConfig(
             parallelism=parallelism, batch_size=batch, test_set_size=64,
-            chaos=chaos,
+            chaos=chaos, telemetry=telemetry,
         )
     )
     create = {
@@ -181,7 +181,22 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         # paths — the launch-cost twin of the enqueue->emit latencies
         "serve_launch_p50_ms": round(timing["serve_p50_ms"], 4),
         "serve_launch_p99_ms": round(timing["serve_p99_ms"], 4),
+        # transport-codec wall time, surfaced from the Statistics report
+        # itself (ISSUE 13 satellite: previously visible only on the
+        # codec objects) — zero with codec none
+        "codec_encode_seconds": round(stats.codec_encode_seconds, 4),
+        "codec_decode_seconds": round(stats.codec_decode_seconds, 4),
+        # launch-dispatch percentile gauges from the report (folded only
+        # with the telemetry plane armed — they are wall-clock values,
+        # and unarmed reports stay reproducible)
+        "launch_p50_ms": round(stats.launch_p50_ms, 4),
+        "launch_p99_ms": round(stats.launch_p99_ms, 4),
     }
+    if telemetry:
+        tel = job.telemetry
+        out["heartbeats"] = tel.heartbeats_emitted
+        out["spans_completed"] = tel.spans.completed
+        out["phase_table"] = job.phase_table(elapsed)
     if codec != "none":
         out["codec_seconds"] = round(_codec_seconds(job), 4)
     if job.spmd_bridges:
@@ -1098,6 +1113,15 @@ def main() -> None:
              "leaves the fault-free loss envelope",
     )
     ap.add_argument(
+        "--telemetry-smoke", action="store_true",
+        help="CI gate: telemetry plane end to end — the armed leg must "
+             "match the unarmed leg's score/counters BITWISE (the plane "
+             "only adds performance entries), cost <= 3%% throughput on "
+             "paired trials, emit heartbeats on the count-clocked "
+             "cadence, attribute the hot loop to phases, and write "
+             "sampled round spans; NONZERO EXIT otherwise",
+    )
+    ap.add_argument(
         "--guard-smoke", action="store_true",
         help="CI gate: model-integrity guard end to end — a poisoned run "
              "(seeded NaN + exploding deltas) must finish inside the "
@@ -1420,6 +1444,121 @@ def main() -> None:
             "per_pipeline": per,
             "cohort": coh,
             "holdout_parity": {"per_pipeline": pp, "cohort": pc},
+            "failures": failures,
+        }))
+        if failures:
+            sys.exit(1)
+        return
+
+    if args.telemetry_smoke:
+        # CI gate (ISSUE 13 acceptance):
+        #  (a) UNARMED bit-identity — the telemetry-armed leg's score /
+        #      fitted / communication counters must equal the unarmed
+        #      leg's exactly (the plane only ever ADDS performance
+        #      entries; it must never perturb the computation);
+        #  (b) armed overhead <= 3% on the packed host path (4 paired
+        #      off/on trials, best pair ratio — the same share-throttled-
+        #      box methodology as the guard gate);
+        #  (c) the plane ENGAGES: count-clocked heartbeats at the
+        #      statsEvery cadence, a phase table attributing >= half the
+        #      measured wall (stage/holdout/fit; hub protocol math is
+        #      deliberately unattributed), and a nonempty sampled-span
+        #      JSONL keyed by the transport stamps.
+        import tempfile
+
+        records = min(args.records, 48_000)
+        par = min(args.parallelism, 4)
+        batch = min(args.batch, 64)
+        stats_every = 4_096
+        rng = np.random.RandomState(13)
+        w = np.random.RandomState(42).randn(28)
+        tx = rng.randn(records, 28).astype(np.float32)
+        ty = (tx @ w > 0).astype(np.float32)
+        span_path = os.path.join(
+            tempfile.mkdtemp(prefix="omldm-telemetry-smoke-"),
+            "spans.jsonl",
+        )
+        tel_spec = (
+            f"statsEvery={stats_every},traceSample=16,spanPath={span_path}"
+        )
+        failures = []
+        # warmup compiles the shared programs for both legs
+        run_one("Synchronous", tx[:2048], ty[:2048], par, batch)
+        run_one(
+            "Synchronous", tx[:2048], ty[:2048], par, batch,
+            telemetry=f"statsEvery={stats_every}",
+        )
+        best_off = best_on = None
+        pair_ratios = []
+        for _trial in range(4):
+            r_off = run_one("Synchronous", tx, ty, par, batch)
+            r_on = run_one(
+                "Synchronous", tx, ty, par, batch, telemetry=tel_spec
+            )
+            pair_ratios.append(
+                r_off["examples_per_sec"]
+                / max(r_on["examples_per_sec"], 1e-9)
+            )
+            if best_off is None or (
+                r_off["examples_per_sec"] > best_off["examples_per_sec"]
+            ):
+                best_off = r_off
+            if best_on is None or (
+                r_on["examples_per_sec"] > best_on["examples_per_sec"]
+            ):
+                best_on = r_on
+        overhead = min(pair_ratios)
+        for key in ("score", "fitted", "models_shipped", "bytes_on_wire",
+                    "num_of_blocks"):
+            if best_off[key] != best_on[key]:
+                failures.append(
+                    f"armed leg diverged on {key}: {best_on[key]} != "
+                    f"unarmed {best_off[key]}"
+                )
+        if overhead > 1.03:
+            failures.append(
+                f"telemetry-armed throughput {overhead:.3f}x slower than "
+                "unarmed (> 3% bar)"
+            )
+        # heartbeats fire at the first event/block boundary at/after
+        # statsEvery records — the packed route feeds 8192-row blocks,
+        # so the cadence clamps to block granularity here
+        expected_beats = max(records // max(stats_every, 8192) - 1, 1)
+        if best_on.get("heartbeats", 0) < expected_beats:
+            failures.append(
+                f"heartbeat cadence did not engage: "
+                f"{best_on.get('heartbeats', 0)} beats < {expected_beats} "
+                f"expected at statsEvery={stats_every}"
+            )
+        coverage = best_on.get("phase_table", {}).get("_coverage", 0.0)
+        if coverage < 0.5:
+            failures.append(
+                f"phase table attributes only {coverage:.2f} of the "
+                "measured wall (< 0.5)"
+            )
+        if best_on.get("spans_completed", 0) == 0:
+            failures.append("no protocol-round spans completed")
+        try:
+            span_lines = open(span_path).read().splitlines()
+        except OSError:
+            span_lines = []
+        if not span_lines:
+            failures.append(f"span file {span_path} is empty/missing")
+        else:
+            span = json.loads(span_lines[0])
+            for key in ("networkId", "seq", "op", "rttMs"):
+                if key not in span:
+                    failures.append(f"span records missing {key!r}")
+        print(json.dumps({
+            "config": "protocol_comparison_telemetry_smoke",
+            "records": records,
+            "telemetry_spec": tel_spec,
+            "telemetry_overhead_x": round(overhead, 3),
+            "pair_ratios": [round(r, 3) for r in pair_ratios],
+            "phase_coverage": coverage,
+            "spans_written": len(span_lines),
+            "unarmed": best_off,
+            "armed": best_on,
             "failures": failures,
         }))
         if failures:
@@ -1841,7 +1980,14 @@ def main() -> None:
 
     out = {}
     for protocol in PROTOCOLS:
-        out[protocol] = run_one(protocol, x, y, args.parallelism, args.batch)
+        # the full-comparison rows run telemetry-armed (heartbeats off,
+        # phases on) so every result row carries the phase-breakdown
+        # table + launch gauges alongside the traffic counters — BENCH
+        # rounds see WHERE each protocol's wall time goes
+        out[protocol] = run_one(
+            protocol, x, y, args.parallelism, args.batch,
+            telemetry="statsEvery=100000000",
+        )
 
     # SPMD collective engine: same stream, same scoring, the 6 protocols
     # with device-plane equivalents on the 8-worker virtual mesh
@@ -1901,6 +2047,13 @@ def main() -> None:
                 "metric": "per-protocol examples/sec, score, traffic",
                 "parallelism": args.parallelism,
                 "records": args.records,
+                # the host-plane protocol rows run TELEMETRY-ARMED as of
+                # PR 13 (phase tables + launch gauges in every row):
+                # examples_per_sec carries the plane's <= 3% hook
+                # overhead, so cross-round trends against earlier
+                # unarmed rows see that baseline shift, not a protocol
+                # change
+                "telemetry_armed_rows": True,
                 "protocols": out,
                 "protocols_spmd": out_spmd,
                 **codec_out,

@@ -788,6 +788,72 @@ def _gen_stream_file(path, n_records, dim, seed=0):
     return os.path.getsize(path)
 
 
+def bench_phase_attribution(path, dim, n_records, batch=256):
+    """Phase-attributed breakdown of the streaming host-plane run (ISSUE
+    13): the SAME JSON-lines stream through the packed host route with
+    the telemetry plane armed — file read + C parse timed around the
+    batch iterator, stage/holdout attributed by the spoke's phase hooks,
+    fit by the flush StepTimer — so the ingest-wall work of ROADMAP #5
+    starts from measured attribution. ``coverage`` is the fraction of the
+    measured end-to-end wall the phase table accounts for (the acceptance
+    bar is >= 0.9: anything unattributed is runtime glue, not a hot
+    phase)."""
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.fast_ingest import iter_file_batches
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    def _make_job():
+        job = StreamJob(JobConfig(
+            parallelism=1, batch_size=batch, test_set_size=64,
+            telemetry="statsEvery=1000000",
+        ))
+        job.process_event(REQUEST_STREAM, json.dumps({
+            "id": 0,
+            "request": "Create",
+            "learner": {
+                "name": "PA",
+                "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": dim},
+            },
+            "trainingConfiguration": {"protocol": "CentralizedTraining"},
+        }))
+        return job
+
+    def _timed_run(job):
+        phases = job.telemetry.phases
+        it = iter_file_batches(path, dim, 32768)
+        t_start = time.perf_counter()
+        while True:
+            t0 = time.perf_counter()
+            b = next(it, None)
+            # file read + C block parse live inside the iterator; the
+            # fused route cannot split them, so both attribute to parse
+            phases.note("parse", time.perf_counter() - t0)
+            if b is None:
+                break
+            job.process_packed_batch(*b)
+        return time.perf_counter() - t_start
+
+    warm = _make_job()
+    _timed_run(warm)  # warmup job compiles the shared fit programs
+    warm.terminate()
+    job = _make_job()  # fresh accounting: phases cover ONE measured run
+    e2e = _timed_run(job)
+    table = job.phase_table(e2e)
+    job.terminate()
+    return {
+        "examples_per_sec": round(n_records / e2e, 1),
+        "e2e_s": round(e2e, 3),
+        "coverage": table.get("_coverage", 0.0),
+        "phases": {
+            k: v for k, v in table.items() if k != "_coverage"
+        },
+    }
+
+
 def _make_e2e_job(dim, parallelism, chain):
     from omldm_tpu.config import JobConfig
     from omldm_tpu.runtime import StreamJob
@@ -1004,10 +1070,16 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
     float(np.asarray(bridge_r.trainer.global_flat_params()[0]))
     t_raw_overlapped = time.perf_counter() - t0
 
+    # --- phase-attributed breakdown of the streaming host run (ISSUE 13):
+    # the same stream through the telemetry-armed packed host route, so
+    # the e2e number above ships with measured per-phase attribution
+    phase_attribution = bench_phase_attribution(tmp.name, dim, n_records)
+
     os.unlink(tmp.name)
     return "e2e_json_to_params", overlapped_measured, {
         "basis": "e2e stream-fed, MEASURED double-buffered overlapped run",
         "records": n_records,
+        "phase_attribution": phase_attribution,
         "stream_mb": round(n_bytes / 1e6, 1),
         "overlapped_measured_examples_per_sec": round(overlapped_measured, 1),
         "overlapped_samples_s": [round(t, 3) for t in overlapped_samples],

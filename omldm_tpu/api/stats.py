@@ -120,6 +120,23 @@ class Statistics:
     # in-process runtime, whose parallelism already rides JobStatistics)
     rescales_performed: int = 0
     fleet_processes: int = 0
+    # transport-codec wall time (runtime/codec.py TransportCodec): total
+    # encode/decode seconds spent preparing this pipeline's wire traffic,
+    # folded once per contributor (spoke nets at query/terminate, hub
+    # shards at terminate) — previously only visible on the codec objects
+    # themselves, invisible in any report. Additive across contributors
+    # (each owns its own codec clock).
+    codec_encode_seconds: float = 0.0
+    codec_decode_seconds: float = 0.0
+    # launch-dispatch percentile GAUGES (utils/tracing.StepTimer rings):
+    # per-launch ms for the fit flush path and the serving predict path,
+    # folded from the spokes' timers at query/terminate and max-combined
+    # across contributors (the same conservative worst-window summary as
+    # the serve-latency percentiles)
+    launch_p50_ms: float = 0.0
+    launch_p99_ms: float = 0.0
+    serve_launch_p50_ms: float = 0.0
+    serve_launch_p99_ms: float = 0.0
     fitted: int = 0
     learning_curve: List[float] = dataclasses.field(default_factory=list)
     lcx: List[int] = dataclasses.field(default_factory=list)
@@ -151,6 +168,8 @@ class Statistics:
         active_version: Optional[int] = None,
         rescales_performed: int = 0,
         fleet_processes: int = 0,
+        codec_encode_seconds: float = 0.0,
+        codec_decode_seconds: float = 0.0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127).
         ``cohort_shards`` and ``pressure_level`` are gauges: max-combined,
@@ -183,6 +202,19 @@ class Statistics:
             self.active_version = active_version
         self.rescales_performed += rescales_performed
         self.fleet_processes = max(self.fleet_processes, fleet_processes)
+        self.codec_encode_seconds += codec_encode_seconds
+        self.codec_decode_seconds += codec_decode_seconds
+
+    def note_launch_ms(self, p50: float, p99: float) -> None:
+        """Fold one contributor's fit-flush launch percentile window in
+        (max-combine, the serve-latency convention)."""
+        self.launch_p50_ms = max(self.launch_p50_ms, p50)
+        self.launch_p99_ms = max(self.launch_p99_ms, p99)
+
+    def note_serve_launch_ms(self, p50: float, p99: float) -> None:
+        """Fold one contributor's serving-launch percentile window in."""
+        self.serve_launch_p50_ms = max(self.serve_launch_p50_ms, p50)
+        self.serve_launch_p99_ms = max(self.serve_launch_p99_ms, p99)
 
     def note_serve_latency(self, p50: float, p99: float, p999: float) -> None:
         """Fold one contributor's serving-latency percentile window in
@@ -267,6 +299,18 @@ class Statistics:
                 self.rescales_performed, other.rescales_performed
             ),
             fleet_processes=max(self.fleet_processes, other.fleet_processes),
+            codec_encode_seconds=self.codec_encode_seconds
+            + other.codec_encode_seconds,
+            codec_decode_seconds=self.codec_decode_seconds
+            + other.codec_decode_seconds,
+            launch_p50_ms=max(self.launch_p50_ms, other.launch_p50_ms),
+            launch_p99_ms=max(self.launch_p99_ms, other.launch_p99_ms),
+            serve_launch_p50_ms=max(
+                self.serve_launch_p50_ms, other.serve_launch_p50_ms
+            ),
+            serve_launch_p99_ms=max(
+                self.serve_launch_p99_ms, other.serve_launch_p99_ms
+            ),
             serve_latency_p50_ms=max(
                 self.serve_latency_p50_ms, other.serve_latency_p50_ms
             ),
@@ -316,6 +360,12 @@ class Statistics:
             "activeVersion": self.active_version,
             "rescalesPerformed": self.rescales_performed,
             "fleetProcesses": self.fleet_processes,
+            "codecEncodeSeconds": self.codec_encode_seconds,
+            "codecDecodeSeconds": self.codec_decode_seconds,
+            "launchP50Ms": self.launch_p50_ms,
+            "launchP99Ms": self.launch_p99_ms,
+            "serveLaunchP50Ms": self.serve_launch_p50_ms,
+            "serveLaunchP99Ms": self.serve_launch_p99_ms,
             "serveLatencyP50Ms": self.serve_latency_p50_ms,
             "serveLatencyP99Ms": self.serve_latency_p99_ms,
             "serveLatencyP999Ms": self.serve_latency_p999_ms,
@@ -340,14 +390,29 @@ class JobStatistics:
     parallelism: int
     duration_ms: float
     statistics: List[Statistics] = dataclasses.field(default_factory=list)
+    # continuous-heartbeat extensions (runtime/telemetry.py): ``kind`` is
+    # None on the terminate-time final report — whose wire shape then
+    # stays EXACTLY the pre-telemetry schema — and "heartbeat" on the
+    # incremental snapshots the armed telemetry plane emits mid-stream,
+    # which also carry their beat ``seq`` and the plane's registry /
+    # queue-depth / phase-table extras (merged top-level into to_dict).
+    kind: Optional[str] = None
+    seq: Optional[int] = None
+    extra: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "jobName": self.job_name,
             "parallelism": self.parallelism,
             "durationMs": self.duration_ms,
             "statistics": [s.to_dict() for s in self.statistics],
         }
+        if self.kind is not None:
+            d["kind"] = self.kind
+            d["seq"] = self.seq
+            for k, v in (self.extra or {}).items():
+                d.setdefault(k, v)
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())

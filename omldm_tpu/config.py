@@ -165,6 +165,24 @@ class JobConfig:
     # any spoke is CRITICAL. Per-pipeline trainingConfiguration.overload
     # always wins (an explicit false opts a pipeline out).
     overload: str = ""
+
+    # --- telemetry plane (runtime/telemetry.py; the reference's only
+    # observability is the terminate-time JobStatistics report on the
+    # performance stream, StatisticsOperator.scala:21-150) ---
+    # Job-wide DEFAULT telemetry spec applied to pipelines whose
+    # trainingConfiguration carries no "telemetry" table of their own,
+    # e.g. "statsEvery=10000,idleMs=2000,traceSample=64" or "on". Empty
+    # (default): nothing is armed — zero telemetry objects exist and
+    # every route is the exact pre-plane code path. Armed, the job emits
+    # continuous performance HEARTBEATS (incremental JobStatistics
+    # snapshots through the on_performance sink, count-clocked every
+    # statsEvery records plus a wall-clock idle tick), attributes
+    # hot-loop wall time to phases (read/parse/stage/holdout/fit/serve/
+    # ship), and samples 1/traceSample protocol rounds into JSONL span
+    # events keyed by the transport's (networkId, seq) stamps.
+    # Per-pipeline trainingConfiguration.telemetry always wins (an
+    # explicit false opts a pipeline out of span sampling).
+    telemetry: str = ""
     # In-memory prediction/response mirror cap: StreamJob keeps every
     # emitted prediction/response in a list for callers WITHOUT sink
     # callbacks; with a sink attached the list is just a mirror, so it is

@@ -50,6 +50,9 @@ class PipelineManager:
             err = self._validate_overload(request)
             if err:
                 return err
+            err = self._validate_telemetry(request)
+            if err:
+                return err
             return self._validate_lifecycle(request)
         if request.request in LIFECYCLE_REQUESTS:
             return self._validate_lifecycle_verb(request)
@@ -69,6 +72,9 @@ class PipelineManager:
                 if err:
                     return err
                 err = self._validate_overload(request)
+                if err:
+                    return err
+                err = self._validate_telemetry(request)
                 if err:
                     return err
                 return self._validate_lifecycle(request)
@@ -172,6 +178,16 @@ class PipelineManager:
         from omldm_tpu.runtime.overload import validate_overload
 
         return validate_overload(request.training_configuration)
+
+    @staticmethod
+    def _validate_telemetry(request: Request) -> Optional[str]:
+        """Telemetry config must be deployable for the same reason as the
+        serving/overload gates: an unknown knob or a spec that arms
+        nothing would raise at deploy and kill the job instead of
+        dropping the one bad request."""
+        from omldm_tpu.runtime.telemetry import validate_telemetry
+
+        return validate_telemetry(request.training_configuration)
 
     def admit(self, request: Request) -> bool:
         """Validate + update the live map; True if the request should be

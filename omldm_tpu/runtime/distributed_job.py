@@ -332,6 +332,13 @@ class DistributedStreamJob:
         self._ckpt_seq = 0
         self._reduce_jits: Dict[Tuple[str, int], Any] = {}
         self._loss_mean_jit = None
+        # serving-launch wall clock (per collective predict round,
+        # including the device wait): recent_p99 rides the heartbeat
+        # frame to the autoscaling supervisor — the host-plane latency
+        # signal the staging-backlog level alone cannot see
+        from omldm_tpu.utils.tracing import StepTimer
+
+        self.serve_timer = StepTimer("dist_serve", cap=8192)
 
     def _warn(self, msg: str) -> None:
         print(f"[distributed p{self.pid}] {msg}", file=sys.stderr)
@@ -376,6 +383,22 @@ class DistributedStreamJob:
         level = max(self._level_window, self.overload_level())
         self._level_window = 0
         return level
+
+    def heartbeat_frame(self) -> dict:
+        """The compact metrics frame this worker's heartbeat file carries
+        (supervisor._beat_frame parses it): the window-peak pressure
+        level plus the signals the level derivation alone cannot
+        express — collective-predict serve p99 ms and the staging
+        backlog row count. ``imbalance`` is 0 here: the distributed
+        engine fans every record to every pipeline, so per-tenant
+        fair-share excess is a host-plane (Spoke) signal — the key stays
+        in the frame so one supervisor parser serves both planes."""
+        return {
+            "level": self.overload_level_window(),
+            "serveP99": round(self.serve_timer.recent_p99(), 3),
+            "imbalance": 0.0,
+            "backlog": int(self.backlog_rows()),
+        }
 
     def _fetch_replicated(self, arr) -> np.ndarray:
         """Host copy of a REPLICATED global array: read the local shard
@@ -943,13 +966,15 @@ class DistributedStreamJob:
                 v_d = host_local_array(
                     v.reshape(self.dp_local, -1, width), self.mesh, P("dp")
                 )
-                preds = self._fetch_replicated(p._predict_jit(
-                    p.trainer.state, x_d, v_d
-                ))
+                with self.serve_timer:
+                    preds = self._fetch_replicated(p._predict_jit(
+                        p.trainer.state, x_d, v_d
+                    ))
             else:
-                preds = self._fetch_replicated(p._predict_jit(
-                    p.trainer.state, x_d
-                ))
+                with self.serve_timer:
+                    preds = self._fetch_replicated(p._predict_jit(
+                        p.trainer.state, x_d
+                    ))
             # the replicated output covers every process's rows; this
             # process's slice starts at pid * cap within the global batch
             mine = preds[self.pid * cap : self.pid * cap + max(rows, 0)]
@@ -1773,16 +1798,29 @@ def _flag_true(flags: Dict[str, str], key: str) -> bool:
     return flags.get(key, "").lower() in ("true", "1", "yes")
 
 
-def _heartbeat(flags: Dict[str, str], pid: int, level: int = 0) -> None:
+def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> None:
     """Touch this process's heartbeat file (the supervisor's liveness
     channel). Called at every synchronized pump point, so a process wedged
     in a collective (peer died) stops beating and gets detected. The file
-    body carries ``<epoch> <pressure-level>`` — the second token is the
-    window-peak overload level the autoscaling supervisor folds across
-    the fleet (absent/zero when the overload plane is unarmed)."""
+    body is the compact metrics frame
+    ``<epoch> <pressure-level> [key=value ...]`` — token 2 is the
+    window-peak overload level and the key=value tail carries the
+    host-plane signals (``serveP99``/``imbalance``/``backlog``) the
+    autoscaling supervisor folds across the fleet
+    (supervisor._beat_frame; a bare int ``frame`` writes the legacy
+    two-token form). Absent/zero when the overload plane is unarmed."""
     d = flags.get("heartbeatDir")
     if not d:
         return
+    if isinstance(frame, dict):
+        level = int(frame.get("level", 0))
+        tail = "".join(
+            f" {k}={frame[k]}"
+            for k in ("serveP99", "imbalance", "backlog")
+            if k in frame
+        )
+    else:
+        level, tail = int(frame), ""
     try:
         os.makedirs(d, exist_ok=True)
         # atomic replace: the supervisor polls this file between writes,
@@ -1790,7 +1828,7 @@ def _heartbeat(flags: Dict[str, str], pid: int, level: int = 0) -> None:
         # autoscaler a phantom level-0 sample mid-burst
         path = os.path.join(d, f"proc{pid}.hb")
         with open(path + ".tmp", "w") as f:
-            f.write(f"{time.time()} {int(level)}")
+            f.write(f"{time.time()} {level}{tail}")
         os.replace(path + ".tmp", path)
     except OSError:
         pass  # a full/odd disk must not kill the job over telemetry
@@ -1879,7 +1917,7 @@ def _chunk_tick(
     crashes fire here too, so a kill lands at one well-defined cut (the
     supervisor then relaunches the fleet with --restore, Flink's
     global-restart strategy)."""
-    _heartbeat(flags, job.pid, job.overload_level_window())
+    _heartbeat(flags, job.pid, job.heartbeat_frame())
     every = int(flags.get("checkpointEvery", "0"))
     root = flags.get("checkpointDir")
     if every > 0 and root and (chunk_idx + 1) % every == 0:
@@ -2502,23 +2540,34 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
     ) and os.path.exists(os.path.join(flags["checkpointDir"], "LATEST"))
     if not restoring:
         _sync_requests_from_flags(job, flags)
-    if flags.get("kafkaBrokers"):
-        # a job may start with no pipelines: the Create can arrive on the
-        # requests topic mid-run (startupIdleWindows bounds the wait)
-        _drive_kafka(job, flags)
-    else:
-        if not restoring and not job.pipelines:
-            raise SystemExit(
-                "no pipeline deployed: the requests file must contain at "
-                "least one valid Create/Update with "
-                f"dataStructure.nFeatures ({flags.get('requests')!r})"
-            )
-        if job.stream_mode == "sparse" or (
-            restoring and _manifest_is_sparse(flags)
-        ):
-            _drive_file_sparse(job, flags)
+    # --profileDir: jax.profiler trace of this worker's drive loop, one
+    # trace directory PER PROCESS (a shared dir would interleave event
+    # files) — the distributed twin of the single-process CLI flag
+    # (__main__.py). Unset = the no-op context.
+    from omldm_tpu.utils.tracing import trace as _profiler_trace
+
+    profile_dir = flags.get("profileDir")
+    if profile_dir:
+        profile_dir = os.path.join(profile_dir, f"proc{job.pid}")
+    with _profiler_trace(profile_dir):
+        if flags.get("kafkaBrokers"):
+            # a job may start with no pipelines: the Create can arrive on
+            # the requests topic mid-run (startupIdleWindows bounds the
+            # wait)
+            _drive_kafka(job, flags)
         else:
-            _drive_file(job, flags)
+            if not restoring and not job.pipelines:
+                raise SystemExit(
+                    "no pipeline deployed: the requests file must contain "
+                    "at least one valid Create/Update with "
+                    f"dataStructure.nFeatures ({flags.get('requests')!r})"
+                )
+            if job.stream_mode == "sparse" or (
+                restoring and _manifest_is_sparse(flags)
+            ):
+                _drive_file_sparse(job, flags)
+            else:
+                _drive_file(job, flags)
 
     # post-training control-plane sync point: a second request file handled
     # after the stream drains (deterministic query-after-training — the

@@ -179,6 +179,15 @@ class Hub:
 
     def on_terminate(self) -> None:
         self.node.on_terminate()
+        # hub-side transport-codec wall time folds into this shard's
+        # statistics exactly once, at terminate (the spoke-side twin
+        # delta-folds at query/terminate; see Statistics.codec_*_seconds)
+        codec = getattr(self.node, "codec", None)
+        if codec is not None:
+            self.node.stats.update_stats(
+                codec_encode_seconds=codec.encode_seconds,
+                codec_decode_seconds=codec.decode_seconds,
+            )
 
 
 class HubManager:

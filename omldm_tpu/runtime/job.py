@@ -80,6 +80,16 @@ class StreamJob:
         from omldm_tpu.runtime.lifecycle import parse_lifecycle_spec
 
         parse_lifecycle_spec(getattr(self.config, "lifecycle", ""))
+        # telemetry plane (runtime/telemetry.py): armed by the job-wide
+        # JobConfig.telemetry spec here (fail-fast on a malformed one), or
+        # lazily by the first pipeline whose trainingConfiguration carries
+        # a telemetry table (see _deploy). Unarmed (the default): the
+        # attribute stays None, zero telemetry objects exist, and every
+        # route below is the exact pre-plane code path.
+        from omldm_tpu.runtime.telemetry import parse_telemetry_spec
+
+        self.telemetry = None
+        _tel_cfg = parse_telemetry_spec(getattr(self.config, "telemetry", ""))
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         # dead-letter quarantine: malformed / validation-rejected records
         # and requests land here with reason codes instead of vanishing
@@ -121,6 +131,8 @@ class StreamJob:
         self.spokes: List[Spoke] = [
             self._spawn_spoke(i) for i in range(self.config.parallelism)
         ]
+        if _tel_cfg is not None:
+            self._arm_telemetry(_tel_cfg)
         # in-memory mirror trim counters (see _trim_emission)
         self.predictions_trimmed = 0
         self.responses_trimmed = 0
@@ -189,6 +201,7 @@ class StreamJob:
             emit_predictions=self._emit_predictions,
             quarantine=self.dead_letter.quarantine,
             tenant_routing=self._burst is not None,
+            telemetry=self.telemetry,
         )
 
     # --- sinks ---
@@ -304,8 +317,234 @@ class StreamJob:
             hub.node.stats.note_serve_latency(*n)
         elif counter == "shed_latency_ms":
             hub.node.stats.note_shed_latency(n)
+        elif counter == "codec_seconds":
+            hub.node.stats.update_stats(
+                codec_encode_seconds=n[0], codec_decode_seconds=n[1]
+            )
+        elif counter == "launch_ms":
+            hub.node.stats.note_launch_ms(*n)
+        elif counter == "serve_launch_ms":
+            hub.node.stats.note_serve_launch_ms(*n)
         else:
             hub.node.stats.update_stats(**{counter: n})
+
+    # --- telemetry plane (runtime/telemetry.py) --------------------------
+
+    def _arm_telemetry(self, cfg) -> None:
+        """Create the job's TelemetryPlane (idempotent) and hand every
+        spoke the reference — called from __init__ for the job-wide spec,
+        or lazily from _deploy for the first pipeline-armed table."""
+        if self.telemetry is not None:
+            return
+        from omldm_tpu.runtime.telemetry import TelemetryPlane
+
+        plane = TelemetryPlane(cfg)
+        # standing probes: existing accounting publishes into the
+        # registry WITHOUT double bookkeeping on its hot paths — the
+        # registry reads these at snapshot time. serve_launch_p99_ms is
+        # also the overload ladder's latency signal once telemetry is
+        # armed (runtime/overload.OverloadController.signals).
+        plane.registry.probe(
+            "serve_launch_p99_ms",
+            lambda: max(
+                (s.serve_timer.recent_p99() for s in self.spokes),
+                default=0.0,
+            ),
+        )
+        plane.registry.probe(
+            "flush_launch_p99_ms",
+            lambda: max(
+                (s.step_timer.recent_p99() for s in self.spokes),
+                default=0.0,
+            ),
+        )
+        plane.registry.probe("pressure_level", self.overload_level)
+        plane.registry.probe(
+            "queued_rows", lambda: float(sum(
+                v for k, v in self.queue_depths().items()
+                if k not in ("pressure_level",)
+            ))
+        )
+        self.telemetry = plane
+        for spoke in self.spokes:
+            spoke.attach_telemetry(plane)
+
+    def codec_seconds(self) -> Tuple[float, float]:
+        """(encode, decode) transport-codec seconds summed across every
+        live hub and spoke node — the 'ship' phase of the breakdown
+        table, and the live twin of the Statistics codec fields."""
+        enc = dec = 0.0
+        for hub in self.hub_manager.hubs.values():
+            c = getattr(hub.node, "codec", None)
+            if c is not None:
+                enc += c.encode_seconds
+                dec += c.decode_seconds
+        for spoke in self.spokes:
+            for net in spoke.nets.values():
+                c = getattr(net.node, "codec", None)
+                if c is not None:
+                    enc += c.encode_seconds
+                    dec += c.decode_seconds
+        return enc, dec
+
+    def phase_table(self, e2e_s: Optional[float] = None) -> dict:
+        """Phase-attributed hot-loop breakdown: the telemetry plane's
+        measured read/parse/stage/holdout rings plus the phases already
+        clocked elsewhere — fit (spoke flush StepTimers), serve (serving
+        StepTimers) and ship (transport-codec seconds). With ``e2e_s``,
+        each row carries its share of the measured end-to-end wall and
+        ``_coverage`` is the attributed fraction."""
+        from omldm_tpu.runtime.telemetry import PhaseProfile
+
+        tel = self.telemetry
+        profile = (
+            tel.phases if tel is not None and tel.phases is not None
+            else PhaseProfile()
+        )
+        enc, dec = self.codec_seconds()
+        extra = {
+            "fit": sum(s.step_timer.total_ms for s in self.spokes) / 1e3,
+            "serve": sum(s.serve_timer.total_ms for s in self.spokes) / 1e3,
+            "ship": enc + dec,
+        }
+        return profile.table(
+            e2e_s, extra={k: v for k, v in extra.items() if v > 0.0}
+        )
+
+    def heartbeat_statistics(self) -> list:
+        """READ-ONLY per-pipeline Statistics snapshots for a heartbeat:
+        deep copies of the merged hub stats plus the spoke-side tallies
+        that normally fold at query/terminate (launch counts, serving
+        telemetry, overload counters) — peeked, never taken, so the
+        terminate-time fold still sees every delta exactly once. Scores
+        are NOT evaluated (that would dispatch holdout programs into the
+        hot loop); the final report carries them. SPMD-engine pipelines
+        report at terminate only (their statistics walk is collective)."""
+        out = []
+        for net_id in self.pipeline_manager.live_pipelines:
+            if net_id in self.spmd_bridges:
+                continue
+            merged = self.hub_manager.network_statistics(net_id)
+            s = (
+                copy.deepcopy(merged) if merged is not None
+                else None
+            )
+            if s is None:
+                from omldm_tpu.api.stats import Statistics
+
+                s = Statistics(pipeline=net_id)
+            fitted = 0
+            for spoke in self.spokes:
+                net = spoke.nets.get(net_id)
+                if net is None:
+                    continue
+                s.update_stats(
+                    program_launches=net.program_launches,
+                    forecasts_served=net.serve_stats.count,
+                )
+                if net.serve_stats.count:
+                    s.note_serve_latency(*net.serve_stats.percentiles())
+                # the HOST-side fitted counter only: query_stats() would
+                # also read cumulative_loss, which forces a cohort state
+                # checkout (launching staged gang fits EARLY) and breaks
+                # the armed-vs-unarmed bit-identity contract
+                fitted += int(net.pipeline.fitted)
+                ctl = spoke.overload
+                if ctl is not None:
+                    s.update_stats(
+                        forecasts_shed=ctl._shed.get(net_id, 0),
+                        records_throttled=ctl._throttled.get(net_id, 0),
+                        pressure_level=ctl.level_peak,
+                    )
+                if net.lifecycle is not None:
+                    s.update_stats(
+                        active_version=net.lifecycle.active_version
+                    )
+                c = getattr(net.node, "codec", None)
+                if c is not None:
+                    # live totals minus what already folded hub-side
+                    s.update_stats(
+                        codec_encode_seconds=(
+                            c.encode_seconds - net._codec_folded[0]
+                        ),
+                        codec_decode_seconds=(
+                            c.decode_seconds - net._codec_folded[1]
+                        ),
+                    )
+            for (nid, _h), hub in self.hub_manager.hubs.items():
+                if nid != net_id:
+                    continue
+                c = getattr(hub.node, "codec", None)
+                if c is not None:
+                    # hub shards fold only at terminate, so mid-stream
+                    # the live totals are the un-folded delta
+                    s.update_stats(
+                        codec_encode_seconds=c.encode_seconds,
+                        codec_decode_seconds=c.decode_seconds,
+                    )
+            if s.fitted == 0:
+                s.fitted = fitted
+            nq = self.dead_letter.record_count
+            if nq:
+                s.update_stats(records_quarantined=nq)
+            if self.rescales_performed:
+                s.update_stats(rescales_performed=self.rescales_performed)
+            out.append(s)
+        return out
+
+    def _emit_heartbeat(self, now: Optional[float] = None) -> None:
+        """One incremental JobStatistics snapshot through the existing
+        on_performance sink (the Kafka ``performance`` topic) — the
+        continuous form of the terminate-time report. ``kind`` marks it a
+        heartbeat so consumers (and JobTerminator semantics) can tell it
+        from the final report; the extras carry the registry snapshot,
+        queue depths and the phase table."""
+        tel = self.telemetry
+        seq = tel.mark_beat(now)
+        start = self.stats.job_start
+        now = time.time() if now is None else now
+        report = JobStatistics(
+            job_name=self.config.job_name,
+            parallelism=self.config.parallelism,
+            duration_ms=(
+                (now - start) * 1000.0 if start is not None else 0.0
+            ),
+            statistics=self.heartbeat_statistics(),
+            kind="heartbeat",
+            seq=seq,
+            extra={
+                "eventsProcessed": self.events_processed,
+                "telemetry": tel.registry.snapshot(),
+                "queues": self.queue_depths(),
+                "phases": self.phase_table(),
+            },
+        )
+        self._emit_performance(report)
+
+    def heartbeat_frame(self) -> dict:
+        """The compact metrics frame a worker heartbeat file carries to
+        the autoscaling supervisor (runtime/supervisor._beat_frame):
+        pressure level plus the host-plane signals the staging backlog
+        alone cannot see — serving launch p99, the hottest tenant's
+        fair-share imbalance excess, and the queued-row backlog."""
+        p99 = max(
+            (s.serve_timer.recent_p99() for s in self.spokes), default=0.0
+        )
+        imbalance = 0.0
+        backlog = 0
+        for spoke in self.spokes:
+            if spoke.overload is not None:
+                imbalance = max(imbalance, spoke.overload._hot)
+            depths = spoke.queue_depths()
+            backlog += depths["serving"] + depths["batcher"] + depths[
+                "throttled"
+            ]
+        return {
+            "level": self.overload_level(),
+            "serveP99": round(p99, 3),
+            "imbalance": round(imbalance, 3),
+            "backlog": int(backlog),
+        }
 
     # --- event handling ---
 
@@ -316,12 +555,22 @@ class StreamJob:
         if gang is None or not self._any_cohorts():
             # no live cohorts: rounds average inline, the pre-cohort timing
             self._process_event_inner(stream, payload)
-            return
-        # cohort gang-averaging window: PS rounds completed while this
-        # event processes stage their contribution matrices and average
-        # together (one stacked reduction per cohort) at window exit
-        with gang.window():
-            self._process_event_inner(stream, payload)
+        else:
+            # cohort gang-averaging window: PS rounds completed while this
+            # event processes stage their contribution matrices and average
+            # together (one stacked reduction per cohort) at window exit
+            with gang.window():
+                self._process_event_inner(stream, payload)
+        # heartbeat count clock: one tick per event (packed blocks tick
+        # row counts inside process_packed_batch); emission happens at
+        # the event boundary, after the event's own work settled
+        tel = self.telemetry
+        if (
+            tel is not None
+            and stream != PACKED_STREAM
+            and tel.note_records(1)
+        ):
+            self._emit_heartbeat()
 
     def _any_cohorts(self) -> bool:
         return any(
@@ -345,7 +594,12 @@ class StreamJob:
             if isinstance(payload, DataInstance):
                 inst = payload
             else:
-                inst, reason = DataInstance.parse(payload)
+                tel = self.telemetry
+                if tel is not None and tel.phases is not None:
+                    with tel.phases.phase("parse"):
+                        inst, reason = DataInstance.parse(payload)
+                else:
+                    inst, reason = DataInstance.parse(payload)
                 if reason is not None:
                     # EOS markers / blank lines return (None, None) and
                     # pass through silently — they are protocol, not poison
@@ -510,6 +764,22 @@ class StreamJob:
             spmd_engine_supported,
         )
 
+        # lazy telemetry arming: the first pipeline whose
+        # trainingConfiguration carries a telemetry table creates the
+        # job's plane (the gate already validated the spec; job-wide
+        # arming happened at __init__)
+        if self.telemetry is None:
+            from omldm_tpu.runtime.telemetry import telemetry_config
+
+            try:
+                tel_cfg = telemetry_config(
+                    request.training_configuration,
+                    getattr(self.config, "telemetry", ""),
+                )
+            except (ValueError, TypeError):
+                tel_cfg = None  # gate-validated; belt and braces
+            if tel_cfg is not None:
+                self._arm_telemetry(tel_cfg)
         use_spmd = spmd_engine_requested(request) and spmd_engine_supported(request)
         # an Update must tear down the previous deployment on EITHER plane
         if request.id in self._dims:
@@ -708,9 +978,19 @@ class StreamJob:
         gang = self.hub_manager.gang
         if gang is None or not self._any_cohorts():
             self._process_packed_inner(x, y, op)
-            return
-        with gang.window():
-            self._process_packed_inner(x, y, op)
+        else:
+            with gang.window():
+                self._process_packed_inner(x, y, op)
+        # heartbeat count clock: packed blocks tick their ROW count so
+        # the cadence is the same pure function of the record sequence
+        # whichever ingest route carried the rows
+        tel = self.telemetry
+        if (
+            tel is not None
+            and not self.stats.terminated
+            and tel.note_records(int(x.shape[0]))
+        ):
+            self._emit_heartbeat()
 
     def _process_packed_inner(
         self, x: "np.ndarray", y: "np.ndarray", op: "np.ndarray"
@@ -918,6 +1198,12 @@ class StreamJob:
         next record to flush it."""
         for spoke in self.spokes:
             spoke.poll_serving()
+        # telemetry idle tick: a stalled/paused stream with activity
+        # pending since the last beat still reports (wall-clocked — the
+        # count clock cannot advance while nothing flows)
+        tel = self.telemetry
+        if tel is not None and not self.stats.terminated and tel.idle_due(now):
+            self._emit_heartbeat(now)
         if self.stats.silence_exceeded(now):
             return self.terminate()
         return None
@@ -986,4 +1272,9 @@ class StreamJob:
         # release the dead-letter file handle (supervised restarts open a
         # fresh one per incarnation; a late quarantine reopens on demand)
         self.dead_letter.close()
+        # ... and the telemetry span file (the final report above is the
+        # terminate-time JobStatistics, bit-identical to the pre-plane
+        # schema — heartbeats only ever ADD performance entries)
+        if self.telemetry is not None:
+            self.telemetry.close()
         return report

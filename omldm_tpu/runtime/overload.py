@@ -424,7 +424,14 @@ class OverloadController:
 
     def signals(self) -> Dict[str, float]:
         """The raw pressure signals (observability + the tick input;
-        ``hot`` is as of the last boundary rebalance)."""
+        ``hot`` is as of the last boundary rebalance).
+
+        The serve-launch p99 — the one wall-clock signal — is measured
+        when its threshold knob arms it (``p99HighMs > 0``, the
+        pre-telemetry contract) OR when the job's telemetry plane is
+        armed: arming telemetry makes the latency signal available to
+        the ladder without a separate knob (the thresholds still gate
+        whether it ACTS; un-thresholded it is observability only)."""
         spoke = self.spoke
         plane = getattr(spoke, "serving_plane", None)
         out = {
@@ -433,7 +440,9 @@ class OverloadController:
             "backlog": float(self.backlog_rows()),
         }
         cfg = self.config
-        if cfg is not None and cfg.p99_high_ms > 0:
+        if (cfg is not None and cfg.p99_high_ms > 0) or getattr(
+            spoke, "telemetry", None
+        ) is not None:
             out["p99_ms"] = spoke.serve_timer.recent_p99()
         return out
 

@@ -64,6 +64,7 @@ from omldm_tpu.runtime.serving import (
     _entry_rows,
     serving_config,
 )
+from omldm_tpu.runtime.telemetry import telemetry_config
 from omldm_tpu.runtime.vectorizer import (
     F32_MAX,
     MicroBatcher,
@@ -228,6 +229,17 @@ class SpokeNet:
         # reference is attached by the hosting Spoke at create time.
         self.overload = overload_config(tc, getattr(config, "overload", ""))
         self._octl: Optional[OverloadController] = None
+        # telemetry plane (trainingConfiguration.telemetry /
+        # JobConfig.telemetry): per-net opt-in/out for SPAN sampling — an
+        # explicit false excludes this pipeline's protocol rounds from
+        # the job plane's sampled spans (runtime/telemetry.py). The plane
+        # itself lives on the job; None here only gates the span hook.
+        self.telemetry_cfg = telemetry_config(
+            tc, getattr(config, "telemetry", "")
+        )
+        # transport-codec seconds already folded into hub statistics
+        # (delta-folding: query + terminate must never double-count)
+        self._codec_folded = (0.0, 0.0)
         # model-lifecycle plane (trainingConfiguration.lifecycle /
         # JobConfig.lifecycle): when armed, this net owns a per-pipeline
         # model-version registry — Shadow candidates twin-train on the
@@ -441,6 +453,10 @@ class Spoke:
         # injector is armed — its clones are tenant-addressed); False =
         # metadata-carrying records broadcast exactly as pre-plane
         tenant_routing: bool = False,
+        # job-level telemetry plane (runtime/telemetry.TelemetryPlane) or
+        # None: gates the span hooks and the phase-attribution hooks —
+        # one attribute read on every path when unarmed
+        telemetry=None,
     ):
         self.worker_id = worker_id
         self.config = config
@@ -494,6 +510,18 @@ class Spoke:
         self.overload: Optional[OverloadController] = None
         self._quarantine = quarantine
         self.tenant_routing = tenant_routing
+        # telemetry plane reference + its phase profile (split so the hot
+        # paths read one attribute): set at construction when the job is
+        # already armed, or later through attach_telemetry (lazy
+        # pipeline-table arming, rescale-grown spokes)
+        self.telemetry = telemetry
+        self._phases = (
+            telemetry.phases if telemetry is not None else None
+        )
+        # cached (count, (p50, p99)) per timer name: the terminate probe
+        # folds per net, and re-sorting the launch ring per tenant would
+        # make a 256-tenant terminate quadratic in ring length
+        self._tp_cache: Dict[str, Tuple[int, Tuple[float, float]]] = {}
         # pre-creation buffering (SpokeLogic.scala:31-35)
         self.record_buffer: DataSet[DataInstance] = DataSet(config.record_buffer_cap)
         # packed-row pre-creation buffer: whole (x, y, op) blocks with the
@@ -613,6 +641,25 @@ class Spoke:
                 net.node.paused = False
                 self._drain_pause_buffer(net)
 
+    def attach_telemetry(self, plane) -> None:
+        """Hand this spoke the job's telemetry plane (lazy arming by the
+        first pipeline-level telemetry table, or job-armed construction
+        racing rescale-grown spokes)."""
+        self.telemetry = plane
+        self._phases = plane.phases
+
+    def _timer_percentiles(self, timer: StepTimer) -> Tuple[float, float]:
+        """(p50, p99) ms of a StepTimer's retained window, cached by the
+        timer's total count so a multi-tenant terminate probe sorts each
+        ring once, not once per net."""
+        cached = self._tp_cache.get(timer.name)
+        if cached is not None and cached[0] == timer.count:
+            return cached[1]
+        sm = timer.summary()
+        out = (sm["p50_ms"], sm["p99_ms"])
+        self._tp_cache[timer.name] = (timer.count, out)
+        return out
+
     def _make_send(self, network_id: int):
         def send(op: str, payload: Any, hub_id: int = 0) -> None:
             # reliable channel: stamp the per-(net, worker->hub) sequence
@@ -620,6 +667,19 @@ class Spoke:
             # above the possibly-lossy router)
             net = self.nets.get(network_id)
             seq = net.next_seq(hub_id) if net is not None else None
+            # sampled round tracing: 1/traceSample sends open a span
+            # keyed by the transport stamp; the next hub delivery on this
+            # stream closes it with the round-trip latency
+            tel = self.telemetry
+            if (
+                tel is not None
+                and tel.spans.active
+                and net is not None
+                and net.telemetry_cfg is not None
+            ):
+                tel.spans.maybe_open(
+                    network_id, hub_id, self.worker_id, op, seq
+                )
             self._send_to_hub(
                 network_id, hub_id, self.worker_id, op, payload, seq
             )
@@ -689,7 +749,15 @@ class Spoke:
                         )
                         touched = True
                         continue
-            x = net.vectorizer.vectorize(inst)
+            ph = self._phases
+            if ph is None:
+                x = net.vectorizer.vectorize(inst)
+            else:
+                # per-record featurization is the record path's share of
+                # the ``stage`` phase (the packed routes attribute their
+                # bulk add_many calls the same way)
+                with ph.phase("stage"):
+                    x = net.vectorizer.vectorize(inst)
             if net.node.paused:
                 # hold, don't drop: the net resumes on the next toggle.
                 # Only forecasts need the original instance (for the
@@ -841,7 +909,17 @@ class Spoke:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Vectorized 8-of-10 holdout split over a packed segment; evicted
         test points re-enter the training flow at the slot of the row that
-        evicted them. Identity when test mode is off."""
+        evicted them. Identity when test mode is off. Phase-attributed as
+        ``holdout`` when the telemetry plane is armed."""
+        ph = self._phases
+        if ph is None:
+            return self._holdout_filter_inner(net, tx, ty)
+        with ph.phase("holdout"):
+            return self._holdout_filter_inner(net, tx, ty)
+
+    def _holdout_filter_inner(
+        self, net: SpokeNet, tx: np.ndarray, ty: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
         if not self.config.test:
             return tx, ty
         n = tx.shape[0]
@@ -887,7 +965,7 @@ class Spoke:
         i = 0
         total = tx.shape[0]
         while i < total:
-            i += net.batcher.add_many(tx[i:], ty[i:])
+            i += self._staged_add(net.batcher, tx, ty, i)
             if net.batcher.full:
                 net.flush_batch()
 
@@ -999,6 +1077,17 @@ class Spoke:
         )
         net.serve_stats.note((time.perf_counter() - t0) * 1000.0)
 
+    def _staged_add(self, batcher, tx, ty, i: int) -> int:
+        """``batcher.add_many(tx[i:], ty[i:])``, phase-attributed as
+        ``stage`` when the telemetry plane is armed (the fit dispatch a
+        full batcher triggers times itself into the flush StepTimer —
+        the two phases never nest)."""
+        ph = self._phases
+        if ph is None:
+            return batcher.add_many(tx[i:], ty[i:])
+        with ph.phase("stage"):
+            return batcher.add_many(tx[i:], ty[i:])
+
     @staticmethod
     def _drain_staged_fits(net: SpokeNet) -> None:
         """Launch a cohort member's staged gang fits BEFORE a serve-timed
@@ -1090,6 +1179,37 @@ class Spoke:
                 self._note_wire(nid, 0, "records_throttled", throttled)
             if ctl.level_peak:
                 self._note_wire(nid, 0, "pressure_level", ctl.level_peak)
+        # transport-codec wall time: encode/decode seconds fold as a
+        # DELTA since the last fold (query + terminate must never count
+        # the same second twice), making codec cost visible in every
+        # report instead of only on the codec object
+        if self._note_wire is not None and net.node.codec is not None:
+            c = net.node.codec
+            enc = c.encode_seconds - net._codec_folded[0]
+            dec = c.decode_seconds - net._codec_folded[1]
+            if enc > 0.0 or dec > 0.0:
+                self._note_wire(
+                    net.request.id, 0, "codec_seconds", (enc, dec)
+                )
+                net._codec_folded = (c.encode_seconds, c.decode_seconds)
+        # launch-dispatch percentile gauges: the spoke's fit-flush and
+        # serving StepTimer windows, max-combined hub-side (cached per
+        # timer count so a multi-tenant probe sorts each ring once).
+        # Folded ONLY with the telemetry plane armed: these are pure
+        # wall-clock values that would otherwise make every unarmed
+        # run's statistics report non-reproducible (the bit-identical
+        # stats pins across the chaos/codec suites compare full dicts)
+        if self._note_wire is not None and self.telemetry is not None:
+            if self.step_timer.count:
+                self._note_wire(
+                    net.request.id, 0, "launch_ms",
+                    self._timer_percentiles(self.step_timer),
+                )
+            if self.serve_timer.count:
+                self._note_wire(
+                    net.request.id, 0, "serve_launch_ms",
+                    self._timer_percentiles(self.serve_timer),
+                )
         # model-lifecycle telemetry: shadow/promotion/rollback counter
         # deltas fold once (same once-semantics as the launch tally); the
         # live version id is a max-combined GAUGE like pressureLevel
@@ -1200,6 +1320,11 @@ class Spoke:
     def _deliver_from_hub(
         self, net: SpokeNet, network_id: int, hub_id: int, op: str, payload: Any
     ) -> None:
+        # sampled round tracing: an outstanding span on this stream
+        # completes with the hub<->spoke round-trip latency
+        tel = self.telemetry
+        if tel is not None and tel.spans.active:
+            tel.spans.maybe_close(network_id, hub_id, self.worker_id, op)
         if net.serving is not None and net.serve_queue.entries:
             # a hub payload may replace this net's model wholesale (round
             # release, broadcast, resync): exact-mode serving drains the
@@ -1768,7 +1893,7 @@ class Spoke:
                 net, ftx, fty, cur = feed
                 if cur >= ftx.shape[0]:
                     continue
-                cur += net.batcher.add_many(ftx[cur:], fty[cur:])
+                cur += self._staged_add(net.batcher, ftx, fty, cur)
                 feed[3] = cur
                 if net.batcher.full:
                     net.flush_batch()
@@ -1823,7 +1948,7 @@ class Spoke:
         i = 0
         total = tx.shape[0]
         while i < total:
-            i += batcher.add_many(tx[i:], ty[i:])
+            i += self._staged_add(batcher, tx, ty, i)
             if batcher.full:
                 for net in members:
                     # every member's model is about to change: exact-mode

@@ -82,6 +82,10 @@ SUPERVISOR_ONLY_FLAGS = {
     "scaleDownAfterMs",
     "scaleCooldownMs",
     "maxRescales",
+    # host-plane heartbeat-frame signal thresholds (AutoscalePolicy:
+    # serve p99 ms / tenant-imbalance excess treated as CRITICAL)
+    "scaleP99Ms",
+    "scaleImbalance",
 }
 
 # exit code a worker fleet uses to signal "checkpointed and exiting for a
@@ -153,7 +157,17 @@ class AutoscalePolicy:
     degradation ladder owns that band. ``cooldown_s`` after each rescale
     gives the relaunched fleet time to drain the backlog it inherited
     before the next decision; sustain streaks reset across rescales and
-    restarts (a fresh incarnation's pressure must re-prove itself)."""
+    restarts (a fresh incarnation's pressure must re-prove itself).
+
+    HOST-PLANE SIGNALS: worker heartbeat frames carry more than the
+    pressure level (``serveP99`` ms, ``imbalance`` fair-share excess,
+    ``backlog`` rows — supervisor.fleet_signals folds them). With
+    ``serve_p99_critical_ms`` / ``imbalance_critical`` armed (> 0, off by
+    default), :meth:`decide` treats a folded signal at/over its
+    threshold as CRITICAL pressure even when the backlog-derived level
+    reads OK — closing the gap where a fleet serving at unacceptable
+    latency (or one hot tenant starving its siblings) never looked
+    loaded to the staging-backlog level alone."""
 
     def __init__(
         self,
@@ -164,6 +178,8 @@ class AutoscalePolicy:
         up_after_s: float = 1.0,
         down_after_s: float = 5.0,
         cooldown_s: float = 2.0,
+        serve_p99_critical_ms: float = 0.0,
+        imbalance_critical: float = 0.0,
     ):
         if min_processes < 1:
             raise ValueError(f"minProcesses must be >= 1, got {min_processes}")
@@ -176,9 +192,20 @@ class AutoscalePolicy:
         self.min_processes = min_processes
         self.max_processes = max_processes
         self.scale_factor = scale_factor
+        if serve_p99_critical_ms < 0:
+            raise ValueError(
+                f"serve_p99_critical_ms must be >= 0, got "
+                f"{serve_p99_critical_ms}"
+            )
+        if imbalance_critical < 0:
+            raise ValueError(
+                f"imbalance_critical must be >= 0, got {imbalance_critical}"
+            )
         self.up_after_s = up_after_s
         self.down_after_s = down_after_s
         self.cooldown_s = cooldown_s
+        self.serve_p99_critical_ms = serve_p99_critical_ms
+        self.imbalance_critical = imbalance_critical
         self._crit_since: Optional[float] = None
         self._calm_since: Optional[float] = None
         self._last_rescale: Optional[float] = None
@@ -192,10 +219,40 @@ class AutoscalePolicy:
         self._last_rescale = now
         self.reset()
 
-    def decide(self, nproc: int, level: int, now: float) -> Optional[int]:
+    def effective_level(
+        self, level: int, signals: Optional[Dict[str, float]] = None
+    ) -> int:
+        """Fold the heartbeat-frame host signals into the pressure level:
+        an armed threshold at/over its limit reads as CRITICAL. UNKNOWN
+        (< 0) stays unknown — signals only exist once somebody beat."""
+        if level < 0 or not signals:
+            return level
+        if (
+            self.serve_p99_critical_ms > 0
+            and signals.get("serveP99", 0.0) >= self.serve_p99_critical_ms
+        ):
+            return 2
+        if (
+            self.imbalance_critical > 0
+            and signals.get("imbalance", 0.0) >= self.imbalance_critical
+        ):
+            return 2
+        return level
+
+    def decide(
+        self,
+        nproc: int,
+        level: int,
+        now: float,
+        signals: Optional[Dict[str, float]] = None,
+    ) -> Optional[int]:
         """The target process count to rescale to, or None (hold).
         ``level < 0`` means UNKNOWN (no pressure evidence yet — e.g. a
-        fleet still compiling): both streaks clear and nothing fires."""
+        fleet still compiling): both streaks clear and nothing fires.
+        ``signals`` is the folded heartbeat-frame dict (fleet_signals);
+        armed host-signal thresholds raise the effective level to
+        CRITICAL (see :meth:`effective_level`)."""
+        level = self.effective_level(level, signals)
         if level < 0:
             self._crit_since = None
             self._calm_since = None
@@ -377,19 +434,43 @@ class DistributedJobSupervisor:
         except OSError:
             return now - spawned_at  # no beat yet: clock runs from spawn
 
-    def _beat_level(self, pid: int) -> Optional[int]:
-        """This worker's last-reported pressure level (heartbeat body
-        token 2). None when the worker has not beaten yet (startup /
-        compile); 0 for a legacy-format or garbled beat."""
+    def _beat_frame(self, pid: int) -> Optional[Dict[str, float]]:
+        """This worker's last-reported heartbeat METRICS FRAME:
+        ``{"level", "serveP99", "imbalance", "backlog"}``. The file body
+        is ``<epoch> <level> [key=value ...]`` (distributed_job._heartbeat);
+        legacy two-token ``<epoch> <level>`` beats parse with zero
+        signals, a bare-epoch or torn/garbled beat degrades to level 0
+        (never a crash — the writer's atomic replace makes torn reads
+        rare, not impossible on every filesystem). None when the worker
+        has not beaten yet (startup / compile)."""
         try:
             with open(os.path.join(self.hb_dir, f"proc{pid}.hb")) as f:
                 parts = f.read().split()
         except OSError:
             return None
+        frame = {"level": 0.0, "serveP99": 0.0, "imbalance": 0.0,
+                 "backlog": 0.0}
         try:
-            return int(float(parts[1])) if len(parts) > 1 else 0
-        except (ValueError, IndexError):
-            return 0
+            if len(parts) > 1:
+                frame["level"] = float(parts[1])
+        except ValueError:
+            return frame  # torn/garbled: level 0, no signals
+        for token in parts[2:]:
+            key, sep, value = token.partition("=")
+            if not sep or key not in frame:
+                continue
+            try:
+                frame[key] = float(value)
+            except ValueError:
+                pass  # one torn token must not discard the rest
+        return frame
+
+    def _beat_level(self, pid: int) -> Optional[int]:
+        """This worker's last-reported pressure level (heartbeat body
+        token 2). None when the worker has not beaten yet (startup /
+        compile); 0 for a legacy-format or garbled beat."""
+        frame = self._beat_frame(pid)
+        return None if frame is None else int(frame["level"])
 
     def fleet_pressure(self) -> int:
         """The folded fleet pressure level: max over every worker's
@@ -403,6 +484,26 @@ class DistributedJobSupervisor:
             if lvl is not None
         ]
         return max(levels) if levels else -1
+
+    def fleet_signals(self) -> Optional[Dict[str, float]]:
+        """The folded heartbeat-frame signals across the fleet: worst
+        serve p99 / imbalance (max — one bad worker is the user-visible
+        tail), total backlog (sum — queued work adds up), worst level.
+        None while no worker has beaten yet (unknown, like
+        fleet_pressure's -1)."""
+        frames = [
+            f
+            for f in (self._beat_frame(pid) for pid in range(self.nproc))
+            if f is not None
+        ]
+        if not frames:
+            return None
+        return {
+            "level": max(f["level"] for f in frames),
+            "serveP99": max(f["serveP99"] for f in frames),
+            "imbalance": max(f["imbalance"] for f in frames),
+            "backlog": sum(f["backlog"] for f in frames),
+        }
 
     def _kill_fleet(self, procs: List[subprocess.Popen]) -> None:
         for p in procs:
@@ -491,12 +592,21 @@ class DistributedJobSupervisor:
                             failed=stale,
                         )
                 if self.autoscale is not None and not pending_target:
-                    level = self.fleet_pressure()
+                    # ONE frame read per worker per poll: the level is
+                    # already folded inside the signals, and reading the
+                    # files twice could pair a stale level with fresh
+                    # signals when a worker replaces its beat in between
+                    signals = self.fleet_signals()
+                    level = int(signals["level"]) if signals else -1
                     target = self.autoscale.decide(
-                        self.nproc, level, time.monotonic()
+                        self.nproc, level, time.monotonic(),
+                        signals=signals,
                     )
                     if target is not None and target != self.nproc:
-                        pending_target, decision_level = target, level
+                        pending_target = target
+                        decision_level = self.autoscale.effective_level(
+                            level, signals
+                        )
                         with open(self._signal_path(), "w") as f:
                             f.write(str(target))
                         self._log(
@@ -655,6 +765,14 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
             down_after_s=float(flags.get("scaleDownAfterMs", "5000"))
             / 1000.0,
             cooldown_s=float(flags.get("scaleCooldownMs", "2000")) / 1000.0,
+            # host-plane heartbeat-frame thresholds (off by default):
+            # serve p99 / tenant imbalance at or over these read
+            # CRITICAL. Distributed workers measure serveP99 themselves;
+            # imbalance is fed only by host-plane frames
+            # (StreamJob.heartbeat_frame — the engine's own frames carry
+            # 0.0, see DistributedStreamJob.heartbeat_frame)
+            serve_p99_critical_ms=float(flags.get("scaleP99Ms", "0")),
+            imbalance_critical=float(flags.get("scaleImbalance", "0")),
         )
     sup = DistributedJobSupervisor(
         worker_args,

@@ -48,6 +48,10 @@ class StepTimer:
         self.cap = cap
         self._durations_ms: List[float] = []
         self._total = 0
+        # exact cumulative wall (ms) across ALL recorded steps — the ring
+        # bounds the percentile window, not the total; the telemetry
+        # plane's phase table reads this for fit/serve attribution
+        self.total_ms = 0.0
         # a stack: one shared timer may wrap NESTED steps (a flush whose
         # protocol reply synchronously drains another pipeline's flush)
         self._starts: List[float] = []
@@ -66,6 +70,7 @@ class StepTimer:
         else:
             self._durations_ms.append(float(duration_ms))
         self._total += 1
+        self.total_ms += float(duration_ms)
 
     @property
     def count(self) -> int:
@@ -104,3 +109,4 @@ class StepTimer:
     def reset(self) -> None:
         self._durations_ms = []
         self._total = 0
+        self.total_ms = 0.0
