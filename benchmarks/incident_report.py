@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Pretty-print a flight-recorder incident bundle.
+
+Usage::
+
+    python benchmarks/incident_report.py /path/to/incident-0.json
+    python benchmarks/incident_report.py --blackbox /path/to/blackboxdir
+
+The first form renders a merged bundle written by a supervisor
+(runtime/events.write_bundle). The second gathers the raw per-process ring
+dumps (``blackbox-*.jsonl``) under a directory and merges them on the fly
+(runtime/events.merge_timeline) — useful when a fleet died before any
+supervisor could bundle it.
+
+Output: the bundle meta, the per-kind event counts, any alerts, and the
+fleet timeline as one row per event — relative time, process, count-clock
+position, kind, cause, pipeline/worker and the (networkId, seq) transport
+stamp that ordered it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _fmt_stamp(event: dict) -> str:
+    stamp = event.get("stamp")
+    if stamp is None:
+        return ""
+    return f"net{stamp[0]}#{stamp[1]}"
+
+
+def _fmt_extra(event: dict) -> str:
+    skip = {
+        "id", "kind", "cause", "clock", "wall", "pid", "pipeline",
+        "tenant", "worker", "stamp",
+    }
+    parts = [
+        f"{k}={event[k]}" for k in sorted(event) if k not in skip
+    ]
+    return " ".join(parts)
+
+
+def render(bundle: dict, out=sys.stdout) -> None:
+    meta = bundle.get("meta", {})
+    timeline = bundle.get("timeline", [])
+    print("incident bundle", file=out)
+    for k, v in sorted(meta.items()):
+        print(f"  {k}: {v}", file=out)
+    print(f"  processes: {len(bundle.get('processes', []))} "
+          f"({', '.join(str(p.get('pid')) for p in bundle.get('processes', []))})",
+          file=out)
+    print(f"  events: {len(timeline)}", file=out)
+    by_kind = bundle.get("byKind") or {}
+    if by_kind:
+        print("  by kind: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_kind.items())
+        ), file=out)
+    alerts = [e for e in timeline if e.get("kind") == "alert"]
+    if alerts:
+        print(f"  ALERTS ({len(alerts)}):", file=out)
+        for a in alerts:
+            print(f"    [{a.get('pid')}] {a.get('cause')} "
+                  f"{_fmt_extra(a)}", file=out)
+    if not timeline:
+        return
+    t0 = min(e.get("wall", 0.0) for e in timeline)
+    print("  timeline:", file=out)
+    header = (f"    {'+s':>8}  {'pid':>4} {'clock':>8}  "
+              f"{'kind':<18} {'cause':<24} {'pipe':>4} {'wrk':>3}  "
+              f"{'stamp':<10} detail")
+    print(header, file=out)
+    for e in timeline:
+        rel = e.get("wall", 0.0) - t0
+        print(
+            f"    {rel:>8.3f}  {str(e.get('pid', '')):>4} "
+            f"{e.get('clock', 0):>8}  "
+            f"{e.get('kind', ''):<18} {str(e.get('cause', ''))[:24]:<24} "
+            f"{str(e.get('pipeline', '')):>4} "
+            f"{str(e.get('worker', '')):>3}  "
+            f"{_fmt_stamp(e):<10} {_fmt_extra(e)}",
+            file=out,
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", nargs="?", help="incident bundle JSON file")
+    ap.add_argument(
+        "--blackbox",
+        help="gather + merge raw blackbox-*.jsonl dumps under a directory "
+        "instead of reading a pre-merged bundle",
+    )
+    args = ap.parse_args(argv)
+    if args.blackbox:
+        from omldm_tpu.runtime.events import gather_blackbox, merge_timeline
+
+        streams = gather_blackbox(args.blackbox)
+        if not streams:
+            print(f"no blackbox-*.jsonl dumps under {args.blackbox!r}",
+                  file=sys.stderr)
+            return 1
+        timeline = merge_timeline(streams)
+        counts: dict = {}
+        for e in timeline:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        bundle = {
+            "meta": {"reason": "raw_blackbox", "source": args.blackbox},
+            "processes": [
+                {"pid": s[0].get("pid") if s else None, "events": len(s)}
+                for s in streams
+            ],
+            "byKind": counts,
+            "timeline": timeline,
+        }
+    elif args.bundle:
+        with open(args.bundle, encoding="utf-8") as f:
+            bundle = json.load(f)
+    else:
+        ap.error("pass a bundle file or --blackbox DIR")
+        return 2
+    render(bundle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

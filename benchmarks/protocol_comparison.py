@@ -86,7 +86,8 @@ def _codec_seconds(job) -> float:
 
 def run_one(protocol: str, x, y, parallelism: int, batch: int,
             engine: str = "host", codec: str = "none", chaos: str = "",
-            sync_every: int = 4, guard: bool = False, telemetry: str = ""):
+            sync_every: int = 4, guard: bool = False, telemetry: str = "",
+            events: str = ""):
     import numpy as np
 
     from omldm_tpu.config import JobConfig
@@ -97,7 +98,7 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
     job = StreamJob(
         JobConfig(
             parallelism=parallelism, batch_size=batch, test_set_size=64,
-            chaos=chaos, telemetry=telemetry,
+            chaos=chaos, telemetry=telemetry, events=events,
         )
     )
     create = {
@@ -191,6 +192,11 @@ def run_one(protocol: str, x, y, parallelism: int, batch: int,
         # and unarmed reports stay reproducible)
         "launch_p50_ms": round(stats.launch_p50_ms, 4),
         "launch_p99_ms": round(stats.launch_p99_ms, 4),
+        # flight-recorder counters (runtime/events.py): zero with the
+        # plane unarmed; decision events + watchdog alerts engage under
+        # --incident-smoke
+        "events_recorded": stats.events_recorded,
+        "alerts_raised": stats.alerts_raised,
     }
     if telemetry:
         tel = job.telemetry
@@ -865,6 +871,220 @@ def run_distributed_route(codecs, dim=256, steps=24, batch=32):
     return out
 
 
+# the incident-smoke operating point (ISSUE 14): a guard-armed supervised
+# in-process run with ONE seeded poisoned worker (its params explode at a
+# fixed chunk, syncEvery=1 ships them before the worker-side guard can
+# roll back) and a one-shot injected worker death a few chunks later. The
+# run must leave ONE merged incident bundle whose fleet timeline carries
+# the rejection -> strike -> retire -> restart chain in causal order on
+# the transport stamps, at least one kind="alert" record on the
+# performance sink, and arming the recorder on a clean stream must cost
+# <= 3% (paired trials) with BITWISE-equal scores.
+INCIDENT_RECORDS = 16_000
+INCIDENT_EVENTS_SPEC = "watchdogEvery=2048,shedHigh=1"
+
+
+def run_incident_smoke() -> None:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import PACKED_STREAM, REQUEST_STREAM
+    from omldm_tpu.runtime.recovery import (
+        FaultInjector,
+        JobSupervisor,
+        replayable,
+    )
+
+    records = INCIDENT_RECORDS
+    dim, par, batch, chunk = 28, 2, 64, 512
+    rng = np.random.RandomState(11)
+    w = np.random.RandomState(42).randn(dim)
+    gx = rng.randn(records, dim).astype(np.float32)
+    gy = (gx @ w > 0).astype(np.float32)
+    op = np.zeros((records,), np.uint8)
+    create_line = json.dumps({
+        "id": 0, "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                    "dataStructure": {"nFeatures": dim}},
+        "trainingConfiguration": {
+            "protocol": "Asynchronous", "syncEvery": 1,
+            "guard": {"maxStrikes": 1}, "comm": {"reliable": True},
+        },
+    })
+    failures = []
+    out = {}
+
+    # --- paired clean legs: overhead + bitwise score identity ------------
+    run_one("Asynchronous", gx[:2048], gy[:2048], par, batch, guard=True)
+    run_one("Asynchronous", gx[:2048], gy[:2048], par, batch, guard=True,
+            events=INCIDENT_EVENTS_SPEC)
+    # 4 paired back-to-back trials, best pair (the guard/telemetry-smoke
+    # rule: this box is share-throttled ±25%, and throttle noise only
+    # ever inflates a pair's ratio, so the minimum over pairs is the
+    # tightest available estimate of the systematic recorder overhead)
+    pair_ratios = []
+    clean_off = clean_on = None
+    for _trial in range(4):
+        r_off = run_one("Asynchronous", gx, gy, par, batch, guard=True)
+        r_on = run_one("Asynchronous", gx, gy, par, batch, guard=True,
+                       events=INCIDENT_EVENTS_SPEC)
+        pair_ratios.append(
+            r_off["examples_per_sec"] / max(r_on["examples_per_sec"], 1e-9)
+        )
+        if clean_off is None or (
+            r_off["examples_per_sec"] > clean_off["examples_per_sec"]
+        ):
+            clean_off = r_off
+        if clean_on is None or (
+            r_on["examples_per_sec"] > clean_on["examples_per_sec"]
+        ):
+            clean_on = r_on
+    overhead = min(pair_ratios)
+    if clean_on["score"] != clean_off["score"]:
+        failures.append(
+            f"events-armed clean score {clean_on['score']} != unarmed "
+            f"{clean_off['score']} (bitwise identity broken)"
+        )
+    if overhead > 1.03:
+        failures.append(
+            f"events-armed clean throughput {overhead:.3f}x slower than "
+            "unarmed (> 3% bar)"
+        )
+    if clean_on["events_recorded"] < 1:
+        failures.append("armed clean leg recorded no events at all")
+
+    # --- the supervised incident leg -------------------------------------
+    tmp = tempfile.mkdtemp(prefix="omldm-incident-smoke-")
+    perf = []
+    try:
+        job = StreamJob(
+            JobConfig(
+                parallelism=par, batch_size=batch, test_set_size=64,
+                events=INCIDENT_EVENTS_SPEC, blackbox_path=tmp,
+            ),
+            on_performance=perf.append,
+        )
+        holder = {"job": job}
+        poisoned = [False]
+        poison_chunk, death_rows = 6, 2500
+
+        def make_events():
+            yield (REQUEST_STREAM, create_line)
+            for idx, i in enumerate(range(0, records, chunk)):
+                if idx == poison_chunk and not poisoned[0]:
+                    # the seeded poisoned worker: spoke 1's params explode
+                    # right before this chunk, so its next syncEvery=1
+                    # push ships the poison to the hub's admission gate
+                    poisoned[0] = True
+                    net = holder["job"].spokes[1].nets[0]
+                    flat, _ = net.pipeline.get_flat_params()
+                    net.pipeline.set_flat_params(np.full_like(flat, 1e9))
+                yield (
+                    PACKED_STREAM,
+                    (gx[i:i + chunk], gy[i:i + chunk], op[i:i + chunk]),
+                )
+
+        injector = FaultInjector()
+        injector.arm(job, worker_id=0, after_records=death_rows)
+        sup = JobSupervisor(
+            job, replayable(make_events), max_restarts=1,
+            on_failure=lambda rec: holder.update(job=sup.job),
+        )
+        report = sup.run()
+        out["incident"] = {
+            "worker_death_fired": injector.fired,
+            "restarts": len(sup.failures),
+            "bundle": sup.bundle_path,
+            "alerts_on_sink": sum(1 for p in perf if p.kind == "alert"),
+            "final_score": (
+                round(report.statistics[0].score, 4)
+                if report is not None and report.statistics else None
+            ),
+        }
+        if injector.fired != 1 or len(sup.failures) != 1:
+            failures.append(
+                "injected worker death did not produce exactly one "
+                f"supervised restart (fired={injector.fired}, "
+                f"restarts={len(sup.failures)})"
+            )
+        if not any(p.kind == "alert" for p in perf):
+            failures.append(
+                "no kind=\"alert\" record reached the performance sink"
+            )
+        if sup.bundle_path is None:
+            failures.append("supervisor wrote no merged incident bundle")
+        else:
+            bundle = json.load(open(sup.bundle_path))
+            timeline = bundle["timeline"]
+            kinds = [e["kind"] for e in timeline]
+            out["incident"]["by_kind"] = bundle["byKind"]
+
+            def first(kind, pred=lambda e: True):
+                for i, e in enumerate(timeline):
+                    if e["kind"] == kind and pred(e):
+                        return i
+                return None
+
+            i_rej = first(
+                "delta_rejected", lambda e: e.get("strikes", 0) >= 1
+            )
+            i_ret = first(
+                "worker_retired", lambda e: e["cause"] == "guard_strikes"
+            )
+            i_restart = first("restart")
+            if i_rej is None or i_ret is None or i_restart is None:
+                failures.append(
+                    "bundle missing the rejection/strike/retire/restart "
+                    f"chain (kinds present: {sorted(set(kinds))})"
+                )
+            elif not (i_rej < i_ret < i_restart):
+                failures.append(
+                    "bundle chain out of causal order: rejection@"
+                    f"{i_rej}, retire@{i_ret}, restart@{i_restart}"
+                )
+            if i_rej is not None and timeline[i_rej].get("stamp") is None:
+                failures.append(
+                    "rejection event carries no transport stamp"
+                )
+            # stamped events must read in seq order PER SENDER STREAM
+            # (merge_timeline's contract: independent seq counters —
+            # other workers' channels, other hub shards — are never
+            # cross-compared, so a pooled global assertion would be
+            # stricter than the guarantee)
+            per_stream: dict = {}
+            for e in timeline:
+                if e.get("stamp") and e["stamp"][0] == 0:
+                    key = (e.get("worker"), e.get("hub"),
+                           e.get("side", ""))
+                    per_stream.setdefault(key, []).append(e["stamp"][1])
+            for key, seqs in per_stream.items():
+                if seqs != sorted(seqs):
+                    failures.append(
+                        f"stamped stream {key} not merge-sorted by "
+                        f"seq: {seqs}"
+                    )
+            if "alert" not in kinds:
+                failures.append("bundle carries no alert event")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    print(json.dumps({
+        "config": "protocol_comparison_incident_smoke",
+        "records": records,
+        "clean_events_off": clean_off,
+        "clean_events_on": clean_on,
+        "events_overhead_x": round(overhead, 3),
+        **out,
+        "failures": failures,
+    }))
+    if failures:
+        sys.exit(1)
+
+
 # the autoscale-smoke operating point (ISSUE 12): a preloaded burst on
 # the file-backed Kafka broker, consumed by a SUPERVISED 1-process fleet
 # with pressure-driven autoscaling armed. The burst outpaces the
@@ -1120,6 +1340,17 @@ def main() -> None:
              "paired trials, emit heartbeats on the count-clocked "
              "cadence, attribute the hot loop to phases, and write "
              "sampled round spans; NONZERO EXIT otherwise",
+    )
+    ap.add_argument(
+        "--incident-smoke", action="store_true",
+        help="CI gate: flight recorder end to end — a chaos+guard-armed "
+             "supervised run with a seeded poisoned worker must leave ONE "
+             "merged incident bundle carrying the rejection -> strike -> "
+             "retire -> restart chain in causal order on the transport "
+             "stamps, at least one kind=\"alert\" record must reach the "
+             "performance sink, and arming the recorder on a clean "
+             "stream must cost <= 3%% (paired trials) with BITWISE-equal "
+             "scores; NONZERO EXIT otherwise",
     )
     ap.add_argument(
         "--guard-smoke", action="store_true",
@@ -1563,6 +1794,11 @@ def main() -> None:
         }))
         if failures:
             sys.exit(1)
+        return
+
+    if args.incident_smoke:
+        # CI gate (ISSUE 14 acceptance): see run_incident_smoke
+        run_incident_smoke()
         return
 
     if args.guard_smoke:

@@ -36,6 +36,11 @@ class QueryResponse:
     # shadow scores — riding bucket-0 fragments of lifecycle-armed
     # pipelines; None (the default) keeps the pre-plane wire shape
     lifecycle: Optional[Mapping[str, Any]] = None
+    # flight-recorder observability (runtime/events.py): the tail of the
+    # per-pipeline event ring — the last few decision events tagged with
+    # this pipeline — riding bucket-0 fragments when the recorder is
+    # armed; None (the default) keeps the pre-plane wire shape
+    events: Optional[Sequence[Mapping[str, Any]]] = None
     # internal routing metadata (NOT part of the wire format): which worker
     # emitted this fragment — lets the merger re-assemble parameter buckets
     # from a single replica's fragment set even when replicas differ
@@ -57,6 +62,7 @@ class QueryResponse:
             cumulative_loss=obj.get("cumulativeLoss"),
             score=obj.get("score"),
             lifecycle=obj.get("lifecycle"),
+            events=obj.get("events"),
         )
 
     def to_dict(self) -> dict:
@@ -75,6 +81,8 @@ class QueryResponse:
         }
         if self.lifecycle is not None:
             out["lifecycle"] = dict(self.lifecycle)
+        if self.events is not None:
+            out["events"] = [dict(e) for e in self.events]
         return out
 
     def to_json(self) -> str:

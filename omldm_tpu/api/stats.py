@@ -120,6 +120,13 @@ class Statistics:
     # in-process runtime, whose parallelism already rides JobStatistics)
     rescales_performed: int = 0
     fleet_processes: int = 0
+    # flight-recorder telemetry (runtime/events.py; zero with the plane
+    # unarmed, the default): decision events recorded in the job's
+    # journal and watchdog alerts raised. JOB-level counts mirrored into
+    # each pipeline's report at terminate (the records_quarantined
+    # pattern) — max-combined in merge so cross-hub folds do not multiply
+    events_recorded: int = 0
+    alerts_raised: int = 0
     # transport-codec wall time (runtime/codec.py TransportCodec): total
     # encode/decode seconds spent preparing this pipeline's wire traffic,
     # folded once per contributor (spoke nets at query/terminate, hub
@@ -170,6 +177,8 @@ class Statistics:
         fleet_processes: int = 0,
         codec_encode_seconds: float = 0.0,
         codec_decode_seconds: float = 0.0,
+        events_recorded: int = 0,
+        alerts_raised: int = 0,
     ) -> None:
         """Accumulate communication counters (FlinkHub.scala:118-127).
         ``cohort_shards`` and ``pressure_level`` are gauges: max-combined,
@@ -204,6 +213,11 @@ class Statistics:
         self.fleet_processes = max(self.fleet_processes, fleet_processes)
         self.codec_encode_seconds += codec_encode_seconds
         self.codec_decode_seconds += codec_decode_seconds
+        # job-level mirrors (every fold carries the journal's current
+        # totals): last-write-the-max, not sum, so the heartbeat peek +
+        # terminate fold cannot double-count
+        self.events_recorded = max(self.events_recorded, events_recorded)
+        self.alerts_raised = max(self.alerts_raised, alerts_raised)
 
     def note_launch_ms(self, p50: float, p99: float) -> None:
         """Fold one contributor's fit-flush launch percentile window in
@@ -299,6 +313,10 @@ class Statistics:
                 self.rescales_performed, other.rescales_performed
             ),
             fleet_processes=max(self.fleet_processes, other.fleet_processes),
+            events_recorded=max(
+                self.events_recorded, other.events_recorded
+            ),
+            alerts_raised=max(self.alerts_raised, other.alerts_raised),
             codec_encode_seconds=self.codec_encode_seconds
             + other.codec_encode_seconds,
             codec_decode_seconds=self.codec_decode_seconds
@@ -360,6 +378,8 @@ class Statistics:
             "activeVersion": self.active_version,
             "rescalesPerformed": self.rescales_performed,
             "fleetProcesses": self.fleet_processes,
+            "eventsRecorded": self.events_recorded,
+            "alertsRaised": self.alerts_raised,
             "codecEncodeSeconds": self.codec_encode_seconds,
             "codecDecodeSeconds": self.codec_decode_seconds,
             "launchP50Ms": self.launch_p50_ms,

@@ -61,8 +61,14 @@ def _to_host(tree):
 
 
 # node attributes that are wiring (callables/config) or restored separately
-# (the pipeline), not protocol state
-_NODE_SKIP = frozenset({"pipeline", "config", "send", "reply", "broadcast"})
+# (the pipeline), not protocol state. The flight-recorder journal
+# ("events", re-wired by the runtime like the other callbacks) and its
+# transient receive stamp are wiring too — the journal holds clock
+# closures that must never reach pickle.
+_NODE_SKIP = frozenset({
+    "pipeline", "config", "send", "reply", "broadcast", "events",
+    "_rx_stamp",
+})
 
 
 def _node_state(node) -> dict:

@@ -183,6 +183,36 @@ class JobConfig:
     # Per-pipeline trainingConfiguration.telemetry always wins (an
     # explicit false opts a pipeline out of span sampling).
     telemetry: str = ""
+
+    # --- flight recorder (runtime/events.py; the reference's failure
+    # story is a black box: JobTerminator.scala:6-10 kills the job by
+    # throwing on the first performance record, leaving no record of
+    # what went wrong) ---
+    # Job-wide DEFAULT events spec applied to pipelines whose
+    # trainingConfiguration carries no "events" table of their own, e.g.
+    # "cap=4096,watchdogEvery=10000,shedHigh=1" or "on". Empty (default):
+    # nothing is armed — zero recorder objects exist and every route is
+    # the exact pre-plane code path. Armed, every plane's decision sites
+    # (guard trip/rollback/eviction, delta rejection + strike, quorum
+    # release, resync, shed/throttle + pressure transitions, canary
+    # transitions, rescale decisions, supervisor restarts) record typed
+    # events into a bounded per-process journal; on guard trip, worker
+    # death, rescale, or terminate the ring dumps to JSONL under
+    # ``blackbox_path``; and the watchdog rule knobs (collapseFrac /
+    # p99BudgetMs / shedHigh / curveSlope / silenceMs) emit ``alert``
+    # events through the journal AND onto the performance sink as
+    # kind="alert" records. Per-pipeline trainingConfiguration.events
+    # always wins (an explicit false opts a pipeline out). NOTE: on the
+    # CLI this spec rides the --flightRecorder flag — the bare --events
+    # flag already names the combined replay FILE (__main__.py) and is
+    # excluded from config mapping in from_args.
+    events: str = ""
+    # Directory for flight-recorder ring dumps (blackbox-proc<N>.jsonl)
+    # and supervisor incident bundles (incident-*.json). "" (default) =
+    # in-memory ring only. The events spec's own blackboxPath knob wins
+    # when set; this is the job-wide CLI-friendly default
+    # (--blackboxPath).
+    blackbox_path: str = ""
     # In-memory prediction/response mirror cap: StreamJob keeps every
     # emitted prediction/response in a list for callers WITHOUT sink
     # callbacks; with a sink attached the list is just a mirror, so it is
@@ -220,6 +250,13 @@ class JobConfig:
         camelCase, and the reference's own flag names (e.g. ``timeout``)."""
         cfg = cls()
         args = dict(args)
+        # the bare --events CLI flag names the combined replay FILE
+        # (__main__.py), not the flight-recorder spec: drop it from
+        # config mapping and accept the spec as --flightRecorder instead
+        # (programmatic JobConfig(events=...) is unaffected)
+        args.pop("events", None)
+        if "flightRecorder" in args:
+            args["events"] = args.pop("flightRecorder")
         for alias, field_name in cls._FLAG_ALIASES.items():
             if alias in args and field_name not in args:
                 args[field_name] = args.pop(alias)

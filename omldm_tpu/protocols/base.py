@@ -29,6 +29,13 @@ from omldm_tpu.api.stats import Statistics
 from omldm_tpu.guard import admission_reason, guard_config
 from omldm_tpu.pipelines import MLPipeline
 from omldm_tpu.runtime.codec import make_transport_codec
+from omldm_tpu.runtime.events import (
+    DELTA_REJECTED,
+    QUORUM_RELEASE,
+    RESYNC,
+    WORKER_READMITTED,
+    WORKER_RETIRED,
+)
 from omldm_tpu.runtime.messages import (
     OP_NACK,
     OP_RESYNC,
@@ -243,6 +250,15 @@ class HubNode:
         # it so same-cohort shards average in one stacked reduction. None
         # (the default) = every round averages inline, the pre-cohort path.
         self.gang = None
+        # flight-recorder journal (runtime/events.EventJournal): set by
+        # the HubManager when the plane is armed; the admission/liveness/
+        # quorum decision sites below record through it. None (the
+        # default) = one attribute read per site. ``_rx_stamp`` is the
+        # transport stamp of the message currently being dispatched
+        # (stashed by Hub.receive), so decision events carry the
+        # (networkId, seq) key the fleet bundle merge-orders on.
+        self.events = None
+        self._rx_stamp = None
         # --- hub-side worker liveness (comm.quorum / comm.workerTimeoutMs) ---
         # With a quorum configured, a worker silent beyond the timeout is
         # RETIRED from round accounting (the hub-side half of the
@@ -301,6 +317,16 @@ class HubNode:
     def liveness_armed(self) -> bool:
         return self.quorum is not None
 
+    def _event(self, kind: str, cause: str, **fields) -> None:
+        """Flight-recorder hook (one attribute read when unarmed):
+        records tagged with this pipeline — the admission/liveness/
+        quorum/resync decision sites all ship through here
+        (runtime/events.py)."""
+        if self.events is not None:
+            self.events.record(
+                kind, cause, pipeline=self.network_id, **fields
+            )
+
     def _retired(self) -> Set[int]:
         """Workers excluded from round accounting: liveness-retired
         (silent past the deadline) plus guard-retired (repeat poisoned
@@ -330,6 +356,10 @@ class HubNode:
         self._last_seen[worker_id] = now
         if worker_id in self._retired_live:
             self._retired_live.discard(worker_id)
+            self._event(
+                WORKER_READMITTED, "sign_of_life", worker=worker_id,
+                stamp=self._rx_stamp, hub=self.hub_id,
+            )
             self.resync_worker(worker_id)
 
     def check_liveness(self) -> None:
@@ -350,6 +380,10 @@ class HubNode:
             if now - seen > self.worker_timeout_s:
                 self._retired_live.add(w)
                 retired_any = True
+                self._event(
+                    WORKER_RETIRED, "liveness_timeout", worker=w,
+                    silent_s=round(now - seen, 3), hub=self.hub_id,
+                )
                 self.worker_retired(w)
         if retired_any:
             self._barrier_recheck()
@@ -371,6 +405,11 @@ class HubNode:
         while workers are liveness-retired are quorum releases."""
         if self._retired_live:
             self.stats.update_stats(quorum_releases=1)
+            self._event(
+                QUORUM_RELEASE, "retired_worker_excluded",
+                active=self.round_target(),
+                retired=sorted(self._retired()),
+            )
 
     # --- hub-side delta admission (trainingConfiguration.guard) --------------
 
@@ -397,6 +436,10 @@ class HubNode:
                 # violation votes carry no model to judge health by)
                 self._guard_retired.discard(worker_id)
                 self._guard_strikes.pop(worker_id, None)
+                self._event(
+                    WORKER_READMITTED, "healthy_push", worker=worker_id,
+                    stamp=self._rx_stamp, hub=self.hub_id,
+                )
                 self.resync_worker(worker_id)
             elif worker_id in self._guard_strikes and self._carries_params(
                 payload
@@ -406,6 +449,11 @@ class HubNode:
         self.stats.update_stats(deltas_rejected=1)
         strikes = self._guard_strikes.get(worker_id, 0) + 1
         self._guard_strikes[worker_id] = strikes
+        self._event(
+            DELTA_REJECTED, reason, worker=worker_id,
+            stamp=self._rx_stamp, op=op, strikes=strikes,
+            hub=self.hub_id,
+        )
         if (
             strikes >= self.guard_cfg.max_strikes
             and worker_id not in self._guard_retired
@@ -419,6 +467,10 @@ class HubNode:
             # but keeps receiving broadcasts, so a healed model can
             # re-admit it on a later healthy push
             self._guard_retired.add(worker_id)
+            self._event(
+                WORKER_RETIRED, "guard_strikes", worker=worker_id,
+                stamp=self._rx_stamp, strikes=strikes, hub=self.hub_id,
+            )
             self.worker_retired(worker_id)
             self._barrier_recheck()
         if self.codec is not None:
@@ -470,6 +522,10 @@ class HubNode:
         payload = self.resync_payload()
         if payload is None:
             return
+        self._event(
+            RESYNC, "authoritative_reship", worker=worker_id,
+            stamp=self._rx_stamp, hub=self.hub_id,
+        )
         self.stats.update_stats(bytes_on_wire=payload_size(payload))
         self._reply_raw(worker_id, OP_RESYNC, payload)
 

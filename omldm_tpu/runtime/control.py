@@ -53,6 +53,9 @@ class PipelineManager:
             err = self._validate_telemetry(request)
             if err:
                 return err
+            err = self._validate_events(request)
+            if err:
+                return err
             return self._validate_lifecycle(request)
         if request.request in LIFECYCLE_REQUESTS:
             return self._validate_lifecycle_verb(request)
@@ -75,6 +78,9 @@ class PipelineManager:
                 if err:
                     return err
                 err = self._validate_telemetry(request)
+                if err:
+                    return err
+                err = self._validate_events(request)
                 if err:
                     return err
                 return self._validate_lifecycle(request)
@@ -178,6 +184,14 @@ class PipelineManager:
         from omldm_tpu.runtime.overload import validate_overload
 
         return validate_overload(request.training_configuration)
+
+    @staticmethod
+    def _validate_events(request: Request) -> Optional[str]:
+        """A malformed flight-recorder table drops its own request at the
+        gate instead of failing the deploy (runtime/events.py)."""
+        from omldm_tpu.runtime.events import validate_events
+
+        return validate_events(request.training_configuration)
 
     @staticmethod
     def _validate_telemetry(request: Request) -> Optional[str]:

@@ -63,6 +63,13 @@ class DeadLetterSink:
         self.by_reason: Dict[str, int] = {}
         self._fh = None
         self._file_failed = False
+        #: flight-recorder journal (runtime/events.EventJournal), wired by
+        #: the job when the recorder is armed: each quarantine entry then
+        #: carries the journal's current high-water event id (``eventId``)
+        #: so a quarantined record cross-references the incident bundle
+        #: that explains it. None (default) = entries keep the exact
+        #: pre-recorder shape.
+        self.event_ring = None
 
     def quarantine(
         self,
@@ -95,6 +102,10 @@ class DeadLetterSink:
         if extra:
             for k, v in extra.items():
                 entry.setdefault(k, v)
+        if self.event_ring is not None:
+            # 0 = quarantined before any decision event was recorded —
+            # still informative (nothing in the bundle precedes it)
+            entry.setdefault("eventId", self.event_ring.high_water)
         self.entries.append(entry)
         if stream == self._request_stream:
             self.request_count += 1

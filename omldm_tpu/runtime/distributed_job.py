@@ -339,9 +339,44 @@ class DistributedStreamJob:
         from omldm_tpu.utils.tracing import StepTimer
 
         self.serve_timer = StepTimer("dist_serve", cap=8192)
+        # flight recorder (runtime/events.py; --events / --blackboxPath):
+        # the distributed engine keeps the JOURNAL half of the plane —
+        # restore/rescale/backpressure decisions record as typed events,
+        # the ring dumps to blackbox-proc<pid>.jsonl at every dirty chunk
+        # tick (so a SIGKILLed worker leaves a near-current ring for the
+        # supervisor's incident bundle) — while the watchdog rule layer
+        # stays host-plane (it reads the in-process metrics registry).
+        # None (default) = zero recorder objects.
+        from omldm_tpu.runtime.events import EventJournal, parse_events_spec
+
+        self.events = None
+        self._ev_clock = 0  # records consumed (the journal's count clock)
+        ev_cfg = parse_events_spec(getattr(config, "events", "") or "")
+        if ev_cfg is not None:
+            self.events = EventJournal(
+                cap=ev_cfg.cap,
+                pid=self.pid,
+                path=(
+                    ev_cfg.blackbox_path
+                    or getattr(config, "blackbox_path", "")
+                ),
+                position=lambda: self._ev_clock,
+                tail_len=ev_cfg.tail,
+            )
 
     def _warn(self, msg: str) -> None:
         print(f"[distributed p{self.pid}] {msg}", file=sys.stderr)
+
+    def _record_event(self, kind: str, cause: str, **fields) -> None:
+        """Flight-recorder hook: one attribute read when unarmed."""
+        if self.events is not None:
+            self.events.record(kind, cause, **fields)
+
+    def note_event_records(self, n: int) -> None:
+        """Advance the journal's count clock (records consumed this
+        incarnation) — called from the chunk tick."""
+        if self.events is not None:
+            self._ev_clock += int(n)
 
     # --- overload control (runtime/overload.py) ---
 
@@ -398,6 +433,16 @@ class DistributedStreamJob:
             "serveP99": round(self.serve_timer.recent_p99(), 3),
             "imbalance": 0.0,
             "backlog": int(self.backlog_rows()),
+            # flight-recorder high-water id + alert count (0 unarmed; the
+            # alert half lives on the host plane, so alerts stays 0 here
+            # — the key rides the frame so one supervisor parser serves
+            # both planes, like imbalance)
+            "events": (
+                self.events.high_water if self.events is not None else 0
+            ),
+            "alerts": (
+                self.events.alerts if self.events is not None else 0
+            ),
         }
 
     def _fetch_replicated(self, arr) -> np.ndarray:
@@ -1547,6 +1592,9 @@ class DistributedStreamJob:
                 "no usable distributed snapshot (every candidate failed "
                 "validation on some process); starting fresh"
             )
+            from omldm_tpu.runtime.events import RESTORE
+
+            self._record_event(RESTORE, "no_usable_snapshot")
             return None
         pointed = d
         if os.path.exists(latest):
@@ -1586,6 +1634,12 @@ class DistributedStreamJob:
                     "--rescaleRestore true (the default) to redistribute "
                     "the snapshot across the new process count."
                 )
+                from omldm_tpu.runtime.events import RESTORE
+
+                self._record_event(
+                    RESTORE, "rescale_restore_disabled",
+                    snapshot_procs=old_n, fleet_procs=self.nproc,
+                )
                 return None
             if not self._rescale_count_pinned:
                 self.rescales_performed += 1
@@ -1594,6 +1648,19 @@ class DistributedStreamJob:
                 f"snapshot across {self.nproc} processes "
                 f"(fleet rows {int(manifest['dp_global'])} -> "
                 f"{self.dp_global}; source stripe re-agreed)"
+            )
+            from omldm_tpu.runtime.events import RESTORE
+
+            self._record_event(
+                RESTORE, "rescale_redistribution",
+                snapshot_procs=old_n, fleet_procs=self.nproc,
+                snapshot=os.path.basename(d),
+            )
+        if old_n == self.nproc and self.events is not None:
+            from omldm_tpu.runtime.events import RESTORE
+
+            self._record_event(
+                RESTORE, "snapshot", snapshot=os.path.basename(d),
             )
         self._ckpt_seq = int(manifest["seq"]) + 1
         # redeploy the pipeline map from the recorded request lines (no
@@ -1816,7 +1883,8 @@ def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> None:
         level = int(frame.get("level", 0))
         tail = "".join(
             f" {k}={frame[k]}"
-            for k in ("serveP99", "imbalance", "backlog")
+            for k in ("serveP99", "imbalance", "backlog", "events",
+                      "alerts")
             if k in frame
         )
     else:
@@ -1873,6 +1941,17 @@ def _maybe_rescale_exit(
         f"rescale signal honored: snapshot {os.path.basename(d)} taken, "
         f"fleet exiting to relaunch at {agreed} processes"
     )
+    if job.events is not None:
+        from omldm_tpu.runtime.events import RESCALE
+
+        job.events.record(
+            RESCALE, "supervisor_signal_agreed",
+            from_procs=job.nproc, to_procs=agreed,
+            snapshot=os.path.basename(d),
+        )
+        # the pre-rescale ring must survive the process exit: this dump
+        # is what the supervisor's incident bundle reads
+        job.events.incident("rescale")
     from omldm_tpu.runtime.supervisor import RESCALE_EXIT
 
     raise SystemExit(RESCALE_EXIT)
@@ -1918,6 +1997,12 @@ def _chunk_tick(
     supervisor then relaunches the fleet with --restore, Flink's
     global-restart strategy)."""
     _heartbeat(flags, job.pid, job.heartbeat_frame())
+    job.note_event_records(records)
+    if job.events is not None and job.events.dirty:
+        # dump-on-dirty: decision events are rare on this engine, so the
+        # atomic ring rewrite is rare too — and a worker killed between
+        # ticks leaves a near-current black box for the bundle
+        job.events.dump()
     every = int(flags.get("checkpointEvery", "0"))
     root = flags.get("checkpointDir")
     if every > 0 and root and (chunk_idx + 1) % every == 0:
@@ -2369,12 +2454,21 @@ def _drive_kafka(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
                         f"overload CRITICAL (backlog {job.backlog_rows()} "
                         "rows): pausing data consumption"
                     )
+                    from omldm_tpu.runtime.events import PAUSE
+
+                    job._record_event(
+                        PAUSE, "overload_critical",
+                        backlog=job.backlog_rows(),
+                    )
             elif level < 2 and data_paused[0]:
                 resume = getattr(consumer, "resume", None)
                 if resume is not None:
                     resume(*assigned)
                 data_paused[0] = False
                 job._warn("overload cleared: resuming data consumption")
+                from omldm_tpu.runtime.events import PAUSE
+
+                job._record_event(PAUSE, "overload_cleared")
         # 2. data: drain this window's records from the assigned
         # partitions. Record values are ACCUMULATED into one line buffer
         # per topic and parsed with a single bulk C call per topic per
@@ -2511,6 +2605,12 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         # the distributed engine's backpressure/pressure signal
         # (runtime/overload.py backlog thresholds); unset = unarmed
         overload=flags.get("overload", ""),
+        # flight recorder: decision-event journal + black-box ring dumps
+        # (runtime/events.py; --flightRecorder, matching the in-process
+        # CLI where bare --events names the replay file); unset = zero
+        # recorder objects
+        events=flags.get("flightRecorder", ""),
+        blackbox_path=flags.get("blackboxPath", ""),
     )
     nproc_flag = int(flags.get("processes", "0"))
     # --processes 1 with no coordinator is a plain single-process run;
@@ -2685,6 +2785,13 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
         _atomic_write_bytes(marker, b"published\n")
     if sinks is not None:
         sinks.close()
+    # final black-box dump: the terminate-time ring is this process's
+    # last word in any incident bundle
+    if job.events is not None:
+        from omldm_tpu.runtime.events import TERMINATE
+
+        job.events.record(TERMINATE, "drive_complete")
+        job.events.dump()
     return 0
 
 

@@ -104,6 +104,16 @@ class Hub:
         authoritative re-push (its codec delta stream re-anchors too).
         Liveness is clocked here as well — a message from anyone is the
         only timer a streaming hub gets."""
+        if self.node.events is not None:
+            # transport stamp of the message being dispatched: the
+            # flight-recorder events this receive triggers (rejection,
+            # retirement, resync, liveness re-admission) carry it, which
+            # is what lets a fleet bundle order the cross-process chain.
+            # Held/reordered deliveries keep the triggering message's
+            # stamp — the decision still happened at this receive.
+            self.node._rx_stamp = (
+                (self.network_id, seq) if seq is not None else None
+            )
         if self.node.liveness_armed:
             self.node.note_worker(worker_id)
             self.node.check_liveness()
@@ -123,6 +133,15 @@ class Hub:
             self.node.stats.update_stats(duplicates_dropped=res.duplicates)
         if res.gap:
             self.node.stats.update_stats(gaps_resynced=1)
+            if self.node.events is not None:
+                from omldm_tpu.runtime.events import GAP_RESYNC
+
+                self.node.events.record(
+                    GAP_RESYNC, "window_gap", pipeline=self.network_id,
+                    worker=worker_id, stamp=(self.network_id, seq),
+                    side="hub", hub=self.hub_id,
+                    expected=res.gap_from, got=res.gap_to,
+                )
             if self.node.codec is not None:
                 # deltas were lost: the rx base no longer matches the
                 # sender's; drop it and make the sender re-anchor
@@ -206,6 +225,9 @@ class HubManager:
         # cached any-shard-armed flag: the per-record liveness tick on the
         # data hot path must cost one attribute read when nothing is armed
         self._any_liveness = False
+        # flight-recorder journal (runtime/events.EventJournal) handed to
+        # every shard's protocol node at creation; None = unarmed
+        self.events = None
         # armed-path striding: the full every-hub walk runs every
         # `liveness_stride` events or when the deadline (min armed
         # workerTimeout / 4) lapses — not once per record/chunk
@@ -255,6 +277,17 @@ class HubManager:
 
         hub = Hub(net_id, hub_id, request, dim, self.config, reply, broadcast)
         hub.node.gang = self.gang
+        # per-pipeline opt-out (trainingConfiguration.events = false): an
+        # opted-out pipeline's shards never record, even with the job
+        # plane armed — the spoke-side events_cfg rule
+        if self.events is not None:
+            from omldm_tpu.runtime.events import events_armed_for
+
+            if events_armed_for(
+                request.training_configuration,
+                getattr(self.config, "events", ""),
+            ):
+                hub.node.events = self.events
         # the tenant-mesh width gauge (Statistics.cohort_shards) is NOT
         # stamped here from config: a pipeline that never cohorts (sparse,
         # host-side, pooled below cohort_min) must report 0, so only the

@@ -90,6 +90,16 @@ class StreamJob:
 
         self.telemetry = None
         _tel_cfg = parse_telemetry_spec(getattr(self.config, "telemetry", ""))
+        # flight recorder (runtime/events.py): armed by the job-wide
+        # JobConfig.events spec here (fail-fast on a malformed one), or
+        # lazily by the first pipeline whose trainingConfiguration carries
+        # an events table (see _deploy). Unarmed (the default): the
+        # attribute stays None, zero recorder objects exist, and every
+        # decision site below pays one attribute read.
+        from omldm_tpu.runtime.events import parse_events_spec
+
+        self.events = None
+        _ev_cfg = parse_events_spec(getattr(self.config, "events", ""))
         self.stats = StatisticsCollector(self.config, self._emit_performance)
         # dead-letter quarantine: malformed / validation-rejected records
         # and requests land here with reason codes instead of vanishing
@@ -133,6 +143,8 @@ class StreamJob:
         ]
         if _tel_cfg is not None:
             self._arm_telemetry(_tel_cfg)
+        if _ev_cfg is not None:
+            self._arm_events(_ev_cfg)
         # in-memory mirror trim counters (see _trim_emission)
         self.predictions_trimmed = 0
         self.responses_trimmed = 0
@@ -202,6 +214,9 @@ class StreamJob:
             quarantine=self.dead_letter.quarantine,
             tenant_routing=self._burst is not None,
             telemetry=self.telemetry,
+            events=(
+                self.events.journal if self.events is not None else None
+            ),
         )
 
     # --- sinks ---
@@ -369,6 +384,107 @@ class StreamJob:
         for spoke in self.spokes:
             spoke.attach_telemetry(plane)
 
+    # --- flight recorder (runtime/events.py) -----------------------------
+
+    def _arm_events(self, cfg) -> None:
+        """Create the job's FlightRecorder (idempotent) and hand every
+        spoke + hub shard the journal — called from __init__ for the
+        job-wide spec, or lazily from _deploy for the first pipeline-armed
+        table."""
+        if self.events is not None:
+            return
+        from omldm_tpu.runtime.events import FlightRecorder
+
+        rec = FlightRecorder(
+            cfg,
+            pid=0,
+            position=lambda: self.events_processed,
+            on_alert=self._emit_alert_record,
+            blackbox_default=getattr(self.config, "blackbox_path", ""),
+        )
+        self.events = rec
+        for spoke in self.spokes:
+            spoke.attach_events(rec.journal)
+        # hub shards created before lazy arming, plus (via the manager's
+        # reference) every shard created after it — honoring the same
+        # per-pipeline opt-out rule create_hub applies
+        from omldm_tpu.runtime.events import events_armed_for
+
+        self.hub_manager.events = rec.journal
+        for (nid, _h), hub in self.hub_manager.hubs.items():
+            req = self.pipeline_manager.node_map.get(nid)
+            if req is not None and events_armed_for(
+                req.training_configuration,
+                getattr(self.config, "events", ""),
+            ):
+                hub.node.events = rec.journal
+        # dead-letter entries cross-reference the event ring: each
+        # quarantine carries the current high-water event id, so a
+        # quarantined record points at the bundle that explains it
+        self.dead_letter.event_ring = rec.journal
+
+    def _emit_alert_record(self, event: dict) -> None:
+        """One watchdog alert onto the performance sink as a
+        ``kind="alert"`` record — the live-warning twin of the telemetry
+        heartbeat (statistics stay empty: an alert is a pointer into the
+        journal, not a stats fold)."""
+        start = self.stats.job_start
+        now = time.time()
+        self._emit_performance(JobStatistics(
+            job_name=self.config.job_name,
+            parallelism=self.config.parallelism,
+            duration_ms=(
+                (now - start) * 1000.0 if start is not None else 0.0
+            ),
+            statistics=[],
+            kind="alert",
+            seq=event["id"],
+            extra={"alert": event},
+        ))
+
+    def _watchdog_signals(self) -> dict:
+        """The signals dict one watchdog pass evaluates — read from the
+        PR 13 metrics registry's probes when telemetry is armed, from the
+        same underlying accessors otherwise (peeks, never folds)."""
+        rec = self.events
+        tel = self.telemetry
+        if tel is not None:
+            p99 = tel.registry.read_probe("serve_launch_p99_ms")
+        else:
+            p99 = max(
+                (s.serve_timer.recent_p99() for s in self.spokes),
+                default=0.0,
+            )
+        shed = 0
+        for spoke in self.spokes:
+            ctl = spoke.overload
+            if ctl is not None:
+                shed += ctl.total_shed + ctl.total_throttled
+        loss_points = []
+        for hub in self.hub_manager.hubs.values():
+            curve = hub.node.stats.learning_curve
+            if curve:
+                loss_points.append(curve[-1])
+        shed += sum(
+            h.node.stats.deltas_rejected
+            for h in self.hub_manager.hubs.values()
+        )
+        return {
+            "records": rec.records_seen,
+            "serve_p99_ms": p99,
+            "shed": shed,
+            "loss": (
+                sum(loss_points) / len(loss_points) if loss_points else None
+            ),
+            "last_activity": self.stats.last_activity,
+        }
+
+    def _watchdog_eval(self, now: Optional[float] = None) -> None:
+        rec = self.events
+        if rec is None or rec.watchdog is None:
+            return
+        rec.watchdog.evaluate(self._watchdog_signals(), now)
+
     def codec_seconds(self) -> Tuple[float, float]:
         """(encode, decode) transport-codec seconds summed across every
         live hub and spoke node — the 'ship' phase of the breakdown
@@ -489,6 +605,11 @@ class StreamJob:
                 s.update_stats(records_quarantined=nq)
             if self.rescales_performed:
                 s.update_stats(rescales_performed=self.rescales_performed)
+            if self.events is not None and self.events.journal.total:
+                s.update_stats(
+                    events_recorded=self.events.journal.total,
+                    alerts_raised=self.events.journal.alerts,
+                )
             out.append(s)
         return out
 
@@ -539,11 +660,17 @@ class StreamJob:
             backlog += depths["serving"] + depths["batcher"] + depths[
                 "throttled"
             ]
+        journal = self.events.journal if self.events is not None else None
         return {
             "level": self.overload_level(),
             "serveP99": round(p99, 3),
             "imbalance": round(imbalance, 3),
             "backlog": int(backlog),
+            # flight-recorder high-water id + alert count: the supervisor
+            # can see a worker's journal advance (and alerts fire) without
+            # reading its black box (runtime/events.py)
+            "events": journal.high_water if journal is not None else 0,
+            "alerts": journal.alerts if journal is not None else 0,
         }
 
     # --- event handling ---
@@ -571,6 +698,15 @@ class StreamJob:
             and tel.note_records(1)
         ):
             self._emit_heartbeat()
+        # watchdog count clock: same shape as the heartbeat clock (packed
+        # blocks tick row counts inside process_packed_batch)
+        rec = self.events
+        if (
+            rec is not None
+            and stream != PACKED_STREAM
+            and rec.note_records(1)
+        ):
+            self._watchdog_eval()
 
     def _any_cohorts(self) -> bool:
         return any(
@@ -780,6 +916,20 @@ class StreamJob:
                 tel_cfg = None  # gate-validated; belt and braces
             if tel_cfg is not None:
                 self._arm_telemetry(tel_cfg)
+        # ... and lazy flight-recorder arming, same rule (the gate already
+        # validated the table; job-wide arming happened at __init__)
+        if self.events is None:
+            from omldm_tpu.runtime.events import events_config
+
+            try:
+                ev_cfg = events_config(
+                    request.training_configuration,
+                    getattr(self.config, "events", ""),
+                )
+            except (ValueError, TypeError):
+                ev_cfg = None  # gate-validated; belt and braces
+            if ev_cfg is not None:
+                self._arm_events(ev_cfg)
         use_spmd = spmd_engine_requested(request) and spmd_engine_supported(request)
         # an Update must tear down the previous deployment on EITHER plane
         if request.id in self._dims:
@@ -827,6 +977,19 @@ class StreamJob:
         if n_new < 1:
             raise ValueError(f"parallelism must be >= 1, got {n_new}")
         self.rescales_performed += 1
+        if self.events is not None:
+            # a rescale is an incident-grade decision: record it and dump
+            # the ring (the pre-rescale story must survive the transition)
+            from omldm_tpu.runtime.events import RESCALE
+
+            self.events.journal.record(
+                RESCALE, "live_rescale", from_procs=p, to_procs=n_new
+            )
+            self.events.journal.incident("rescale")
+            # reused worker slots restart their sequence counters at 0:
+            # later stamped events belong to a NEW transport epoch so the
+            # bundle merge never cross-compares them with pre-rescale seqs
+            self.events.journal.bump_epoch()
         if n_new > p:
             for w in range(p, n_new):
                 self.spokes.append(self._spawn_spoke(w))
@@ -991,6 +1154,13 @@ class StreamJob:
             and tel.note_records(int(x.shape[0]))
         ):
             self._emit_heartbeat()
+        rec = self.events
+        if (
+            rec is not None
+            and not self.stats.terminated
+            and rec.note_records(int(x.shape[0]))
+        ):
+            self._watchdog_eval()
 
     def _process_packed_inner(
         self, x: "np.ndarray", y: "np.ndarray", op: "np.ndarray"
@@ -1204,6 +1374,15 @@ class StreamJob:
         tel = self.telemetry
         if tel is not None and not self.stats.terminated and tel.idle_due(now):
             self._emit_heartbeat(now)
+        # watchdog silence rule: wall-clock poll — the count clock cannot
+        # advance while nothing flows, which is when silence matters
+        rec = self.events
+        if (
+            rec is not None
+            and rec.watchdog is not None
+            and not self.stats.terminated
+        ):
+            rec.watchdog.poll_silence(self.stats.last_activity, now)
         if self.stats.silence_exceeded(now):
             return self.terminate()
         return None
@@ -1233,6 +1412,15 @@ class StreamJob:
         # Statistics.records_quarantined field note)
         nq = self.dead_letter.record_count
         nr = self.rescales_performed
+        # flight-recorder totals, mirrored the same way (the journal is
+        # job-level; Statistics.events_recorded/alerts_raised carry it)
+        ne = na = 0
+        if self.events is not None:
+            from omldm_tpu.runtime.events import TERMINATE
+
+            self.events.journal.record(TERMINATE, "termination_protocol")
+            ne = self.events.journal.total
+            na = self.events.journal.alerts
         for bridge in self.spmd_bridges.values():
             bridge.handle_terminate_probe()
             bridge_stats = bridge.network_statistics()
@@ -1241,6 +1429,10 @@ class StreamJob:
                     bridge_stats.update_stats(records_quarantined=nq)
                 if nr:
                     bridge_stats.update_stats(rescales_performed=nr)
+                if ne:
+                    bridge_stats.update_stats(
+                        events_recorded=ne, alerts_raised=na
+                    )
             self.stats.add_hub_statistics(bridge.request.id, bridge_stats)
         self.hub_manager.on_terminate()
         for net_id in self.pipeline_manager.live_pipelines:
@@ -1253,6 +1445,10 @@ class StreamJob:
                     # into each pipeline's report (rescales touch every
                     # live pipeline's replicas)
                     merged.update_stats(rescales_performed=nr)
+                if ne:
+                    merged.update_stats(
+                        events_recorded=ne, alerts_raised=na
+                    )
                 merged.normalize(
                     max(
                         len(
@@ -1277,4 +1473,8 @@ class StreamJob:
         # schema — heartbeats only ever ADD performance entries)
         if self.telemetry is not None:
             self.telemetry.close()
+        # final black-box dump: the terminate-time ring is the incident
+        # bundle's last word from this process
+        if self.events is not None:
+            self.events.journal.dump()
         return report

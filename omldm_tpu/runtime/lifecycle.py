@@ -414,6 +414,21 @@ class LifecycleState:
             "canary_rollbacks": 0,
         }
         self.totals = dict(self._pending)
+        # flight-recorder journal + the pipeline id events are tagged
+        # with (wired by the Spoke when the plane is armed); None (the
+        # default) = no recording anywhere in the state machine
+        self.events = None
+        self.net_id: Optional[int] = None
+
+    def _event(self, cause: str, **fields) -> None:
+        """Record one canary state-machine transition (kind
+        ``lifecycle``) when the flight recorder is armed."""
+        if self.events is not None:
+            from omldm_tpu.runtime.events import LIFECYCLE
+
+            self.events.record(
+                LIFECYCLE, cause, pipeline=self.net_id, **fields
+            )
 
     # --- registry views --------------------------------------------------
 
@@ -496,6 +511,7 @@ class LifecycleState:
         self.forecast_clock = 0
         self._fits_since_eval = 0
         self._trim()
+        self._event("shadow_armed", version=v)
         return v
 
     def start_canary(self) -> bool:
@@ -506,6 +522,9 @@ class LifecycleState:
         e.state = CANARY
         self.canary_pct = self.cfg.ramp_from
         self.forecast_clock = 0
+        self._event(
+            "canary_started", version=e.version, pct=self.canary_pct
+        )
         return True
 
     def demote_candidate(
@@ -526,6 +545,10 @@ class LifecycleState:
         self.canary_pct = 0.0
         if reason is not None:
             self._bump("canary_rollbacks")
+            self._event("canary_rolled_back", version=e.version,
+                        reason=reason)
+        else:
+            self._event("candidate_replaced", version=e.version)
         return e
 
     def promote(self, net) -> Any:
@@ -545,6 +568,9 @@ class LifecycleState:
         self.canary_pct = 0.0
         self._bump("canary_promotions")
         self._trim()
+        self._event(
+            "canary_promoted", version=e.version, retired=old.version
+        )
         return e.pipeline
 
     def reactivate(self, entry: VersionEntry, net) -> Any:
@@ -560,6 +586,10 @@ class LifecycleState:
         entry.flat = None  # the live pipeline carries the params again
         self.active_version = entry.version
         self._bump("canary_rollbacks")
+        self._event(
+            "version_reactivated", version=entry.version,
+            demoted=cur.version,
+        )
         return entry.pipeline
 
     # --- stream hooks ----------------------------------------------------

@@ -172,12 +172,17 @@ class StreamSequencer:
 class WindowResult:
     """Outcome of offering one message to a :class:`ReceiveWindow`."""
 
-    __slots__ = ("deliver", "duplicates", "gap")
+    __slots__ = ("deliver", "duplicates", "gap", "gap_from", "gap_to")
 
     def __init__(self) -> None:
         self.deliver: List[Tuple[str, Any]] = []  # in-order (op, payload)
         self.duplicates = 0
         self.gap = False
+        # the span the fast-forward skipped when ``gap`` is True: the
+        # receiver expected ``gap_from`` and jumped to ``gap_to`` — the
+        # detail the flight recorder's gap_resync events carry
+        self.gap_from = 0
+        self.gap_to = 0
 
 
 class ReceiveWindow:
@@ -248,6 +253,8 @@ class ReceiveWindow:
         self._held[seq] = (op, payload)
         if seq - self.expected > self.size or len(self._held) > self.size:
             res.gap = True
+            res.gap_from = self.expected
+            res.gap_to = max(self._held) + 1
             self.gaps_resynced += 1
             for s in sorted(self._held):
                 res.deliver.append(self._held[s])

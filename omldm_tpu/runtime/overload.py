@@ -303,6 +303,14 @@ class OverloadController:
         self.extra_signals: Dict[str, Callable[[], Tuple[float, float, float]]] = {}
         # degraded-serving cache: (tenant, level) -> ServingConfig
         self._eff: Dict[Tuple[int, int], ServingConfig] = {}
+        # flight-recorder journal (runtime/events.EventJournal) or None:
+        # ladder transitions record through it, and shed/throttle volume
+        # records AGGREGATED at evaluation ticks (one event per window of
+        # activity, never one per flooded record — the recorder must stay
+        # far cheaper than the flood it documents)
+        self.events = None
+        self._ev_shed = 0
+        self._ev_throttled = 0
 
     # --- membership ------------------------------------------------------
 
@@ -496,7 +504,34 @@ class OverloadController:
                 self._below = 0
         if self.level > self.level_peak:
             self.level_peak = self.level
+        if self.events is not None:
+            self._record_events(old)
         return old, self.level
+
+    def _record_events(self, old: int) -> None:
+        """Flight-recorder fold at an evaluation tick: one ``pressure``
+        event per ladder transition, one aggregated ``shed``/``throttle``
+        event per window with new volume (count-clocked — same-seed
+        bursts replay the same event stream)."""
+        from omldm_tpu.runtime.events import PRESSURE, SHED, THROTTLE
+
+        if self.level != old:
+            self.events.record(
+                PRESSURE, LEVEL_NAMES[self.level], old=old, new=self.level,
+                hot=round(self._hot, 3), over=sorted(self._over),
+            )
+        if self.total_shed > self._ev_shed:
+            self.events.record(
+                SHED, "overload_critical",
+                rows=self.total_shed - self._ev_shed,
+            )
+            self._ev_shed = self.total_shed
+        if self.total_throttled > self._ev_throttled:
+            self.events.record(
+                THROTTLE, "overload_elevated",
+                rows=self.total_throttled - self._ev_throttled,
+            )
+            self._ev_throttled = self.total_throttled
 
     def idle_tick(self, rows: Optional[int] = None) -> None:
         """Advance the count clock while the source is PAUSED (upstream

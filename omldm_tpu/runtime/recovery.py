@@ -136,6 +136,32 @@ class JobSupervisor:
         self._ckpt_floor = (
             manager.latest_path() if manager is not None else None
         )
+        # flight recorder (runtime/events.py): with the supervised job's
+        # recorder armed, the supervisor keeps its OWN decision journal
+        # (worker-death detection, restart + restore decisions), dumps
+        # each failed incarnation's ring before replacing it, and writes
+        # one merged incident bundle at the end of the run. Unarmed job
+        # (the default) = zero recorder objects here too.
+        self.journal = None
+        self.bundle_path: Optional[str] = None
+        self._gathered: List[List[dict]] = []
+        self._ensure_journal()
+
+    def _ensure_journal(self):
+        """The supervisor's own decision journal, created as soon as the
+        CURRENT job incarnation's recorder exists — at construction for a
+        job-wide spec, or on the first failure/bundle write for a job
+        whose plane armed LAZILY (a pipeline events table arriving
+        mid-stream)."""
+        if self.journal is None:
+            rec = getattr(self.job, "events", None)
+            if rec is not None:
+                from omldm_tpu.runtime.events import EventJournal
+
+                self.journal = EventJournal(
+                    cap=1024, pid="sup", path=rec.journal.path
+                )
+        return self.journal
 
     def run(self, terminate_on_end: bool = True) -> Optional[JobStatistics]:
         from omldm_tpu.utils.backoff import with_backoff
@@ -164,20 +190,66 @@ class JobSupervisor:
         # Flink's fixed-delay restart strategy through the one shared
         # backoff implementation: max_restarts retries at a constant delay
         # (+ optional jitter so a fleet of supervised jobs desynchronizes)
-        return with_backoff(
-            attempt,
-            attempts=self.max_restarts + 1,
-            base_delay=self.restart_delay_s,
-            growth=1.0,
-            jitter=self.restart_jitter_s,
-            retry_on=(Exception,),
-            on_retry=on_retry,
+        try:
+            return with_backoff(
+                attempt,
+                attempts=self.max_restarts + 1,
+                base_delay=self.restart_delay_s,
+                growth=1.0,
+                jitter=self.restart_jitter_s,
+                retry_on=(Exception,),
+                on_retry=on_retry,
+            )
+        finally:
+            # one merged incident bundle per supervised run: every failed
+            # incarnation's gathered ring + the final job's ring + the
+            # supervisor's own decision log, merge-ordered on the
+            # transport stamps (runtime/events.py)
+            self._write_bundle()
+
+    def _write_bundle(self) -> None:
+        rec = getattr(self.job, "events", None)
+        if rec is None or self._ensure_journal() is None:
+            return
+        from omldm_tpu.runtime.events import write_bundle
+
+        streams = list(self._gathered)
+        if rec.journal.events:
+            streams.append(rec.journal.tail())
+        if self.journal.events:
+            streams.append(self.journal.tail())
+        if not streams or not rec.journal.path:
+            return
+        import os
+
+        self.bundle_path = write_bundle(
+            os.path.join(rec.journal.path, "incident-supervised.json"),
+            streams,
+            meta={
+                "reason": "supervised_run",
+                "restarts": len(self.failures),
+            },
         )
 
     def _recover(self, failed: StreamJob, record: FailureRecord) -> StreamJob:
         """Build the next incarnation: restore the latest checkpoint when
         one exists, else a fresh job from the original config (offset 0)."""
+        rec = getattr(failed, "events", None)
+        if rec is not None:
+            # the failed incarnation's ring is the worker-death incident:
+            # dump it (black box) and gather it (bundle) before the
+            # replacement job's journal takes over
+            rec.journal.incident("worker_death", error=record.error)
+            self._gathered.append(rec.journal.tail())
         job, record.restored_from = recover_job(failed, self._ckpt_floor)
+        if self._ensure_journal() is not None:
+            from omldm_tpu.runtime.events import RESTART
+
+            self.journal.record(
+                RESTART, "worker_failure", error=record.error,
+                offset=record.offset, attempt=len(self.failures),
+                restored_from=record.restored_from,
+            )
         return job
 
 

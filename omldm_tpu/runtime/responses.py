@@ -66,6 +66,11 @@ class ResponseMerger:
                 # count-clocked state machine; keep the last non-null one
                 # (the learner/protocol merge rule) rather than averaging
                 out.lifecycle = dict(f.lifecycle)
+            if f.events is not None:
+                # event-ring tails come from the ONE job-level journal
+                # (every fragment carries the same view): keep the last
+                # non-null one, the lifecycle rule
+                out.events = list(f.events)
             out.data_fitted += f.data_fitted
         n = max(len(heads), 1)
         out.loss = sum((f.loss or 0.0) for f in heads) / n

@@ -37,6 +37,7 @@ from omldm_tpu.runtime.cohort import CohortEngine
 from omldm_tpu.runtime.databuffers import DataSet
 from omldm_tpu.runtime.messages import (
     OP_NACK,
+    OP_RESYNC,
     ReceiveWindow,
     StreamSequencer,
     channel_chaos_spec,
@@ -237,6 +238,16 @@ class SpokeNet:
         self.telemetry_cfg = telemetry_config(
             tc, getattr(config, "telemetry", "")
         )
+        # flight recorder (trainingConfiguration.events /
+        # JobConfig.events): per-net opt-in/out — an explicit false
+        # excludes this pipeline from decision-event recording and from
+        # the Query event tail even when the JOB plane is armed by
+        # another pipeline or the job-wide spec (the telemetry_cfg span
+        # rule). The journal itself lives on the job; None here only
+        # gates this net's recording sites.
+        from omldm_tpu.runtime.events import events_config
+
+        self.events_cfg = events_config(tc, getattr(config, "events", ""))
         # transport-codec seconds already folded into hub statistics
         # (delta-folding: query + terminate must never double-count)
         self._codec_folded = (0.0, 0.0)
@@ -457,6 +468,10 @@ class Spoke:
         # None: gates the span hooks and the phase-attribution hooks —
         # one attribute read on every path when unarmed
         telemetry=None,
+        # job-level flight-recorder journal (runtime/events.EventJournal)
+        # or None: the decision sites below record typed events through
+        # it — one attribute read per site when unarmed
+        events=None,
     ):
         self.worker_id = worker_id
         self.config = config
@@ -518,6 +533,7 @@ class Spoke:
         self._phases = (
             telemetry.phases if telemetry is not None else None
         )
+        self.events = events
         # cached (count, (p50, p99)) per timer name: the terminate probe
         # folds per net, and re-sorting the launch ring per tenant would
         # make a 256-tenant terminate quadratic in ring length
@@ -568,6 +584,12 @@ class Spoke:
             if self.overload is None:
                 self.overload = OverloadController(self)
             self.overload.arm(net)
+            # ladder events are SPOKE-scoped (the controller aggregates
+            # across tenants, its events carry no pipeline tag): any
+            # events-enabled overload tenant arms them; a spoke whose
+            # overload tenants all opted out records nothing
+            if self.events is not None and net.events_cfg is not None:
+                self.overload.events = self.events
         if net.pipeline.guard is not None:
             self._any_guard = True
             # seed the first last-known-good snapshot at the init params:
@@ -576,6 +598,9 @@ class Spoke:
             net.pipeline.guard.maybe_snapshot(net.pipeline)
         if net.lifecycle is not None:
             self._any_lifecycle = True
+            if self.events is not None and net.events_cfg is not None:
+                net.lifecycle.events = self.events
+                net.lifecycle.net_id = net.request.id
         if self.cohorts is not None:
             self.cohorts.consider(net.pipeline)
             # pooled pipelines may attach on a LATER create (auto
@@ -647,6 +672,21 @@ class Spoke:
         racing rescale-grown spokes)."""
         self.telemetry = plane
         self._phases = plane.phases
+
+    def attach_events(self, journal) -> None:
+        """Hand this spoke the job's flight-recorder journal (lazy arming
+        by the first pipeline-level events table) and wire the hosted
+        planes that record their own transitions."""
+        self.events = journal
+        if self.overload is not None and any(
+            net.overload is not None and net.events_cfg is not None
+            for net in self.nets.values()
+        ):
+            self.overload.events = journal
+        for net in self.nets.values():
+            if net.lifecycle is not None and net.events_cfg is not None:
+                net.lifecycle.events = journal
+                net.lifecycle.net_id = net.request.id
 
     def _timer_percentiles(self, timer: StepTimer) -> Tuple[float, float]:
         """(p50, p99) ms of a StepTimer's retained window, cached by the
@@ -1261,6 +1301,17 @@ class Spoke:
                         if i == 0 and net.lifecycle is not None
                         else None
                     ),
+                    # the tail of this pipeline's event ring rides the
+                    # bucket-0 fragment when the flight recorder is armed
+                    # (ResponseMerger keeps the last non-null tail, the
+                    # lifecycle merge rule)
+                    events=(
+                        self.events.tail_for(net.request.id)
+                        if i == 0
+                        and self.events is not None
+                        and net.events_cfg is not None
+                        else None
+                    ),
                     source_worker=self.worker_id,
                 )
             )
@@ -1310,6 +1361,15 @@ class Spoke:
         if res.gap:
             if self._note_wire is not None:
                 self._note_wire(network_id, hub_id, "gaps_resynced", 1)
+            if self.events is not None and net.events_cfg is not None:
+                from omldm_tpu.runtime.events import GAP_RESYNC
+
+                self.events.record(
+                    GAP_RESYNC, "window_gap", pipeline=network_id,
+                    worker=self.worker_id, stamp=(network_id, seq),
+                    side="worker", hub=hub_id,
+                    expected=res.gap_from, got=res.gap_to,
+                )
             if net.node.codec is not None:
                 net.node.codec.reset_rx_stream(f"h{hub_id}>w{self.worker_id}")
                 net.node.codec.reset_rx_stream(f"h{hub_id}>*")
@@ -1325,6 +1385,20 @@ class Spoke:
         tel = self.telemetry
         if tel is not None and tel.spans.active:
             tel.spans.maybe_close(network_id, hub_id, self.worker_id, op)
+        if (
+            self.events is not None
+            and op == OP_RESYNC
+            and net.events_cfg is not None
+        ):
+            # the worker accepted an authoritative re-ship: the recovery
+            # half of a NACK/rejection chain, recorded so the bundle shows
+            # the catch-up landing (not just being decided hub-side)
+            from omldm_tpu.runtime.events import CHANNEL_RESYNC
+
+            self.events.record(
+                CHANNEL_RESYNC, "authoritative_reship",
+                pipeline=network_id, worker=self.worker_id, hub=hub_id,
+            )
         if net.serving is not None and net.serve_queue.entries:
             # a hub payload may replace this net's model wholesale (round
             # release, broadcast, resync): exact-mode serving drains the
@@ -1574,13 +1648,37 @@ class Spoke:
           (OP_NACK -> OP_RESYNC), catching up to the fleet model where one
           exists instead of re-converging from the snapshot alone."""
         nid = net.request.id
+        journal = self.events if net.events_cfg is not None else None
+        if journal is not None:
+            # the trip itself is the incident: record the decision chain
+            # and dump the ring — the post-mortem must not depend on the
+            # stream surviving to terminate
+            from omldm_tpu.runtime.events import GUARD_TRIP
+
+            journal.record(
+                GUARD_TRIP, reason, pipeline=nid, worker=self.worker_id
+            )
         if net.pipeline._cohort is not None and self.cohorts is not None:
             self.cohorts.retire(net.pipeline)
             if self._note_wire is not None:
                 self._note_wire(nid, 0, "members_evicted", 1)
+            if journal is not None:
+                from omldm_tpu.runtime.events import GUARD_EVICT
+
+                journal.record(
+                    GUARD_EVICT, reason, pipeline=nid,
+                    worker=self.worker_id,
+                )
         net.pipeline.guard.rollback(net.pipeline)
         if self._note_wire is not None:
             self._note_wire(nid, 0, "rollbacks_performed", 1)
+        if journal is not None:
+            from omldm_tpu.runtime.events import GUARD_ROLLBACK
+
+            journal.record(
+                GUARD_ROLLBACK, reason, pipeline=nid, worker=self.worker_id
+            )
+            journal.incident("guard_trip", pipeline=nid)
         if net.serving is not None and net.serve_queue.entries:
             # queued forecasts flush through the ROLLED-BACK (last-known-
             # good) model — never through the params the guard condemned

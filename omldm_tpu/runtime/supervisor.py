@@ -348,6 +348,7 @@ class DistributedJobSupervisor:
         poll_interval_s: float = 0.05,
         autoscale: Optional[AutoscalePolicy] = None,
         max_rescales: int = 32,
+        blackbox_dir: Optional[str] = None,
     ):
         if num_processes < 1:
             raise ValueError(f"num_processes must be >= 1, got {num_processes}")
@@ -371,6 +372,25 @@ class DistributedJobSupervisor:
         self.autoscale = autoscale
         self.max_rescales = max_rescales
         self.rescales: List[RescaleRecord] = []
+        # flight recorder (runtime/events.py): with a black-box directory
+        # — the same --blackboxPath the workers dump their rings into —
+        # the supervisor keeps its OWN decision journal (restart/rescale/
+        # scale decisions) and gathers worker dumps + that journal into
+        # one incident bundle on every failure, rescale, and at the end
+        # of the run. None (default) = zero recorder objects.
+        self.blackbox_dir = blackbox_dir
+        self.journal = None
+        self.bundles: List[str] = []
+        # dumps older than this run never enter a bundle (the
+        # _ckpt_floor rule of the in-process supervisor, applied to a
+        # reused black-box directory)
+        self._blackbox_floor = time.time()
+        if blackbox_dir:
+            from omldm_tpu.runtime.events import EventJournal
+
+            self.journal = EventJournal(
+                cap=1024, pid="sup", path=blackbox_dir
+            )
         if autoscale is not None and not self._checkpoint_root():
             # a rescale relaunch without a checkpoint would lose all
             # state; refuse loudly at construction, not mid-burst
@@ -381,6 +401,41 @@ class DistributedJobSupervisor:
 
     def _log(self, msg: str) -> None:
         print(f"[supervisor] {msg}", file=sys.stderr, flush=True)
+
+    def _record(self, kind: str, cause: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.record(kind, cause, **fields)
+
+    def gather_incident(self, reason: str) -> Optional[str]:
+        """Gather the workers' black-box ring dumps plus the supervisor's
+        own decision log into ONE incident bundle (fleet timeline
+        merge-sorted on the transport stamps; runtime/events.py). Called
+        on every fleet failure, every rescale, and at run end — returns
+        the bundle path, or None when no black box is armed."""
+        if not self.blackbox_dir or self.journal is None:
+            return None
+        from omldm_tpu.runtime.events import gather_blackbox, write_bundle
+
+        streams = gather_blackbox(
+            self.blackbox_dir, min_mtime=self._blackbox_floor
+        )
+        if self.journal.events:
+            streams.append(self.journal.tail())
+        path = write_bundle(
+            os.path.join(
+                self.blackbox_dir, f"incident-{len(self.bundles)}.json"
+            ),
+            streams,
+            meta={
+                "reason": reason,
+                "processes": self.nproc,
+                "restarts": len(self.failures),
+                "rescales": len(self.rescales),
+            },
+        )
+        if path is not None:
+            self.bundles.append(path)
+        return path
 
     # --- one attempt -------------------------------------------------------
 
@@ -449,7 +504,7 @@ class DistributedJobSupervisor:
         except OSError:
             return None
         frame = {"level": 0.0, "serveP99": 0.0, "imbalance": 0.0,
-                 "backlog": 0.0}
+                 "backlog": 0.0, "events": 0.0, "alerts": 0.0}
         try:
             if len(parts) > 1:
                 frame["level"] = float(parts[1])
@@ -607,6 +662,13 @@ class DistributedJobSupervisor:
                         decision_level = self.autoscale.effective_level(
                             level, signals
                         )
+                        from omldm_tpu.runtime.events import SCALE
+
+                        self._record(
+                            SCALE, "pressure_sustained",
+                            from_procs=self.nproc, target=target,
+                            level=decision_level,
+                        )
                         with open(self._signal_path(), "w") as f:
                             f.write(str(target))
                         self._log(
@@ -650,6 +712,15 @@ class DistributedJobSupervisor:
             f"rescaling fleet {self.nproc} -> {rescaled.target} processes "
             f"(pressure-driven; rescale {len(self.rescales)})"
         )
+        from omldm_tpu.runtime.events import RESCALE
+
+        self._record(
+            RESCALE, "pressure_driven", from_procs=self.nproc,
+            to_procs=rescaled.target, level=rescaled.level,
+        )
+        # the pre-relaunch worker rings are about to be overwritten by
+        # the new incarnation's dumps: bundle them now (no-op unarmed)
+        self.gather_incident("rescale")
         self.nproc = rescaled.target
         if self.autoscale is not None:
             self.autoscale.note_rescaled(time.monotonic())
@@ -696,6 +767,17 @@ class DistributedJobSupervisor:
                 f"fleet failure ({record.cause}); restart "
                 f"{record.attempt}/{self.max_restarts}"
             )
+            from omldm_tpu.runtime.events import RESTART
+
+            self._record(
+                RESTART, "fleet_failure", error=record.cause,
+                failed=list(record.failed), attempt=record.attempt,
+                restored=record.restored,
+            )
+            # bundle the dead fleet's rings BEFORE the relaunch
+            # overwrites them — this is the supervised-worker-death
+            # incident (no-op unarmed)
+            self.gather_incident("worker_death")
 
         try:
             return with_backoff(
@@ -723,8 +805,20 @@ class DistributedJobSupervisor:
                 f"giving up after {len(self.failures)} failed attempt(s): "
                 f"{exc.cause}"
             )
+            from omldm_tpu.runtime.events import RESTART
+
+            self._record(
+                RESTART, "restarts_exhausted", error=exc.cause,
+                attempts=len(self.failures),
+            )
             raise
         finally:
+            # end-of-run bundle on EVERY exit path — clean completion,
+            # exhausted restarts, or an unexpected escape (operator
+            # interrupt, checkpoint I/O error): the run an operator most
+            # wants a bundle for is the one that did not end cleanly
+            # (the recovery.JobSupervisor finally rule). No-op unarmed.
+            self.gather_incident("run_end")
             if self._own_run_dir:
                 shutil.rmtree(self.run_dir, ignore_errors=True)
 
@@ -786,6 +880,10 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
         run_dir=flags.get("supervisorDir"),
         autoscale=autoscale,
         max_rescales=int(flags.get("maxRescales", "32")),
+        # the workers dump their journal rings here (JobConfig.blackbox
+        # via the passthrough --blackboxPath flag); the supervisor
+        # gathers them + its own decision log into incident bundles
+        blackbox_dir=flags.get("blackboxPath"),
     )
     try:
         return sup.run()

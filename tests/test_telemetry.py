@@ -574,9 +574,16 @@ class TestHeartbeatFrames:
         frame = sup._beat_frame(0)
         assert frame == {
             "level": 1.0, "serveP99": 42.5, "imbalance": 7.25,
-            "backlog": 900.0,
+            "backlog": 900.0, "events": 0.0, "alerts": 0.0,
         }
         assert sup._beat_level(0) == 1
+        # flight-recorder fields (ISSUE 14): events high-water + alert
+        # count parse from the same kv tail
+        self._write_beat(
+            sup, 0, "123.0 1 serveP99=1 events=37 alerts=2"
+        )
+        frame = sup._beat_frame(0)
+        assert frame["events"] == 37.0 and frame["alerts"] == 2.0
 
     def test_legacy_and_torn_frames_degrade(self, tmp_path):
         sup = self._sup(tmp_path)
@@ -612,8 +619,12 @@ class TestHeartbeatFrames:
     def test_streamjob_frame_keys(self):
         job, _ = _run_job(n=60)
         frame = job.heartbeat_frame()
-        assert set(frame) == {"level", "serveP99", "imbalance", "backlog"}
+        assert set(frame) == {
+            "level", "serveP99", "imbalance", "backlog", "events", "alerts",
+        }
         assert frame["level"] == 0 and frame["serveP99"] >= 0.0
+        # flight recorder unarmed: the fields ride at zero
+        assert frame["events"] == 0 and frame["alerts"] == 0
 
     def test_distributed_frame_rides_file(self, tmp_path):
         from omldm_tpu.runtime.distributed_job import _heartbeat
