@@ -1236,6 +1236,282 @@ def run_autoscale_smoke() -> None:
         sys.exit(1)
 
 
+# the selfheal-smoke operating point (ISSUE 15): a supervised 2-process
+# fleet with slot strikes + the collective hang watchdog armed; a seeded
+# SIGSTOP freezes worker 1 at a fixed chunk, the survivor exits HANG_EXIT,
+# the supervisor blames the silent slot, shrinks to the survivor via
+# restore-with-rescale, probes back to full width once quiet, and heals —
+# plus an unarmed-vs-armed-idle identity pair proving the new knobs add
+# nothing to the data path when nothing fires.
+SELFHEAL_ROWS = 6_000
+SELFHEAL_FORE_EVERY = 20
+SELFHEAL_IDENTITY_ROWS = 2_000
+
+
+def _selfheal_identity_pair(tmp: str, env: dict, repo: str) -> list:
+    """Two 1-process file-mode runs of the SAME stream — all self-heal
+    knobs unset vs armed-but-idle (watchdog + fault state dir, no fault):
+    predictions and the report's score/fitted must match BITWISE."""
+    import subprocess
+
+    import numpy as np
+
+    rng = np.random.RandomState(1)
+    w = rng.randn(12)
+    data = os.path.join(tmp, "ident.jsonl")
+    with open(data, "w") as f:
+        for i in range(SELFHEAL_IDENTITY_ROWS):
+            x = np.round(rng.randn(12), 6)
+            if i % SELFHEAL_FORE_EVERY == 0:
+                f.write(json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "operation": "forecasting",
+                }) + "\n")
+            else:
+                f.write(json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "target": float(x @ w > 0), "operation": "training",
+                }) + "\n")
+    reqs = os.path.join(tmp, "ident_reqs.jsonl")
+    with open(reqs, "w") as f:
+        f.write(json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "syncEvery": 1,
+            },
+        }) + "\n")
+    failures = []
+    outs = {}
+    for leg, extra in (
+        ("unarmed", []),
+        ("armed_idle", [
+            "--collectiveTimeoutMs", "60000",
+            "--faultStateDir", os.path.join(tmp, "ident_fault"),
+        ]),
+    ):
+        perf = os.path.join(tmp, f"ident_{leg}_perf.jsonl")
+        preds = os.path.join(tmp, f"ident_{leg}_preds.jsonl")
+        out = subprocess.run(
+            [sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
+             "--processes", "1",
+             "--trainingData", data, "--requests", reqs,
+             "--chunkRows", "200", "--batchSize", "64",
+             "--testSetSize", "32",
+             "--performanceOut", perf, "--predictionsOut", preds]
+            + extra,
+            cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+        )
+        if out.returncode != 0:
+            failures.append(
+                f"identity leg {leg} exited {out.returncode}: "
+                f"{out.stderr[-1500:]}"
+            )
+            return failures
+        report = json.loads(open(perf).read().strip())
+        [stats] = report["statistics"]
+        outs[leg] = (
+            open(preds).read(), stats["score"], stats["fitted"],
+        )
+    if outs["unarmed"] != outs["armed_idle"]:
+        failures.append(
+            "armed-but-idle self-heal knobs changed the data path: "
+            f"unarmed (score {outs['unarmed'][1]}, fitted "
+            f"{outs['unarmed'][2]}) != armed (score "
+            f"{outs['armed_idle'][1]}, fitted {outs['armed_idle'][2]}) "
+            "or predictions differ"
+        )
+    return failures
+
+
+def run_selfheal_smoke() -> None:
+    """CI gate (ISSUE 15 acceptance): a SIGSTOP'd worker must be blamed
+    (survivors exit HANG_EXIT within --collectiveTimeoutMs — no wedged
+    collective), the fleet must shrink to the survivors via restore-with-
+    rescale with fitted+holdout exactly equal to the training rows and
+    every forecast served exactly once, a later probe must restore the
+    full width and heal, the run's bundles must carry the
+    classify -> strike -> degrade -> probe chain in causal order, and the
+    new knobs must be bit-identical no-ops while nothing fires. NONZERO
+    EXIT otherwise."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    sys.path.insert(0, tests)
+    import fskafka
+
+    tmp = tempfile.mkdtemp(prefix="omldm-selfheal-smoke-")
+    broker = os.path.join(tmp, "broker")
+    os.environ["FSKAFKA_DIR"] = broker
+    n_fore = 0
+    try:
+        rng = np.random.RandomState(0)
+        w = rng.randn(12)
+        for i in range(SELFHEAL_ROWS):
+            x = np.round(rng.randn(12), 6)
+            if i % SELFHEAL_FORE_EVERY == 0:
+                n_fore += 1
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "operation": "forecasting",
+                })
+            else:
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "target": float(x @ w > 0),
+                    "operation": "training",
+                })
+            fskafka.append("trainingData", line, partition=i % 4)
+        fskafka.append("requests", json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "syncEvery": 1,
+            },
+        }))
+    finally:
+        os.environ.pop("FSKAFKA_DIR", None)
+
+    boot = (
+        "import sys; sys.path.insert(0, {t!r}); "
+        "import fskafka; fskafka.install(); "
+        "from omldm_tpu.runtime.distributed_job import run_distributed; "
+        "sys.exit(run_distributed(sys.argv[1:]))"
+    ).format(t=tests)
+    perf = os.path.join(tmp, "perf.jsonl")
+    preds = os.path.join(tmp, "preds.jsonl")
+    blackbox = os.path.join(tmp, "blackbox")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FSKAFKA_DIR"] = broker
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
+         "--supervise", "true", "--processes", "2",
+         "--slotStrikes", "1", "--minProcesses", "1",
+         "--probeAfterMs", "2000", "--probeWindowMs", "1500",
+         "--collectiveTimeoutMs", "5000", "--killDeadlineMs", "1000",
+         "--hangProcess", "1", "--hangAfterChunks", "6",
+         "--faultStateDir", os.path.join(tmp, "fault"),
+         "--flightRecorder", "on", "--blackboxPath", blackbox,
+         "--kafkaBrokers", "fs://local", "--workerBoot", boot,
+         "--checkpointDir", os.path.join(tmp, "ckpts"),
+         "--checkpointEvery", "2",
+         "--chunkRows", "100", "--kafkaPollMs", "50",
+         "--idleWindows", "60",
+         "--batchSize", "64", "--testSetSize", "32",
+         "--restartAttempts", "2", "--restartDelayMs", "50",
+         "--performanceOut", perf, "--predictionsOut", preds],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    wall_s = time.perf_counter() - t0
+    err = out.stderr
+    failures = []
+    if out.returncode != 0:
+        failures.append(
+            f"supervised fleet exited {out.returncode}: {err[-2000:]}"
+        )
+    for marker, missing in (
+        ("injected hang: SIGSTOP", "the hang fault never fired"),
+        ("collective watchdog: no progress",
+         "the survivor never exited HANG_EXIT (wedged collective)"),
+        ("blaming wedged process 1",
+         "the supervisor blamed the survivor, not the silent slot"),
+        ("degrading fleet 2 -> 1",
+         "the struck-out slot never triggered shrink-to-survivors"),
+        ("redistributing a 2-process snapshot",
+         "the degrade relaunch did not restore-with-rescale"),
+        ("probing back 1 -> 2",
+         "the degraded fleet never probed back toward full width"),
+        ("fleet healed at 2", "the healthy probe never cleared the strikes"),
+    ):
+        if marker not in err:
+            failures.append(missing)
+    report = {}
+    stats = {}
+    if not failures:
+        report = json.loads(open(perf).read().strip())
+        [stats] = report["statistics"]
+        n_train = SELFHEAL_ROWS - n_fore
+        conserved = stats["fitted"] + report["holdout"]["0"]
+        if conserved != n_train:
+            failures.append(
+                f"records lost across the hang/degrade/probe: "
+                f"fitted+holdout {conserved} != {n_train} training rows"
+            )
+        pred_files = sorted(
+            f for f in os.listdir(tmp) if f.startswith("preds.jsonl")
+        )
+        n_served = sum(
+            1 for f in pred_files for _ in open(os.path.join(tmp, f))
+        )
+        if n_served != n_fore:
+            failures.append(
+                f"forecasts not served exactly once: {n_served} outputs "
+                f"for {n_fore} forecasts"
+            )
+        if report.get("fleetProcesses") != 2:
+            failures.append(
+                "fleet did not return to full width "
+                f"(fleetProcesses {report.get('fleetProcesses')})"
+            )
+        if report.get("fleetDegraded") != 0:
+            failures.append(
+                f"fleetDegraded {report.get('fleetDegraded')} != 0 after "
+                "the heal"
+            )
+        bundles = sorted(
+            f for f in os.listdir(blackbox) if f.startswith("incident-")
+        )
+        if not bundles:
+            failures.append("no incident bundle written")
+        else:
+            final = json.load(open(os.path.join(blackbox, bundles[-1])))
+            kinds = [e["kind"] for e in final["timeline"]]
+            chain = [
+                k for k in kinds if k in ("strike", "degrade", "probe")
+            ]
+            if chain[:3] != ["strike", "degrade", "probe"]:
+                failures.append(
+                    "run-end bundle missing the classify->strike->"
+                    f"degrade->probe chain in order (saw {chain[:6]})"
+                )
+            all_kinds = set()
+            for b in bundles:
+                all_kinds.update(
+                    e["kind"]
+                    for e in json.load(
+                        open(os.path.join(blackbox, b))
+                    )["timeline"]
+                )
+            if "hang" not in all_kinds:
+                failures.append(
+                    "no bundle carries the worker-side hang event"
+                )
+    if not failures:
+        failures += _selfheal_identity_pair(tmp, env, repo)
+    print(json.dumps({
+        "config": "protocol_comparison_selfheal_smoke",
+        "rows": SELFHEAL_ROWS,
+        "forecasts": n_fore,
+        "wall_s": round(wall_s, 1),
+        "fitted": stats.get("fitted"),
+        "score": stats.get("score"),
+        "fleet_processes": report.get("fleetProcesses"),
+        "fleet_degraded": report.get("fleetDegraded"),
+        "failures": failures,
+    }))
+    if failures:
+        sys.exit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--records", type=int, default=50_000)
@@ -1327,6 +1603,18 @@ def main() -> None:
              "EXIT otherwise",
     )
     ap.add_argument(
+        "--selfheal-smoke", action="store_true",
+        help="CI gate: self-healing fleet end to end — a seeded SIGSTOP "
+             "must be detected (survivors exit HANG_EXIT within "
+             "--collectiveTimeoutMs, no wedged collective), the fleet "
+             "must shrink to the survivors via restore-with-rescale with "
+             "fitted+holdout exactly equal to the training rows and every "
+             "forecast served exactly once, a later probe must restore "
+             "full width, the bundles must carry the classify -> strike "
+             "-> degrade -> probe chain in causal order, and unarmed "
+             "knobs must be bit-identical no-ops; NONZERO EXIT otherwise",
+    )
+    ap.add_argument(
         "--chaos-smoke", action="store_true",
         help="CI gate: short Synchronous + Asynchronous runs under seeded "
              "drop+dup+reorder chaos; NONZERO EXIT if a run crashes or "
@@ -1368,6 +1656,11 @@ def main() -> None:
         # dispatch BEFORE the in-process jax/XLA setup below so the
         # parent stays light and its 8-device flag never leaks
         run_autoscale_smoke()
+        return
+
+    if args.selfheal_smoke:
+        # subprocess-driven like the autoscale gate
+        run_selfheal_smoke()
         return
 
     import os

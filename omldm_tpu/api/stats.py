@@ -120,6 +120,18 @@ class Statistics:
     # in-process runtime, whose parallelism already rides JobStatistics)
     rescales_performed: int = 0
     fleet_processes: int = 0
+    # self-healing fleet (runtime/selfheal.py + runtime/supervisor.py):
+    # ``fleet_degraded`` is a GAUGE carrying how many process slots the
+    # supervisor has shrunk away from the configured width after repeated
+    # classified failures (0 = full width; pinned by the supervisor's
+    # --fleetDegraded passthrough, mirrored job-wide like
+    # ``fleet_processes``); ``blackbox_write_errors`` counts telemetry/
+    # quarantine writes the disk refused (black-box ring dumps, dead-letter
+    # file appends, heartbeat files) that degraded to a dropped-write
+    # counter instead of killing the worker (ENOSPC survival) — a
+    # job-level mirror, max-combined like events_recorded
+    fleet_degraded: int = 0
+    blackbox_write_errors: int = 0
     # flight-recorder telemetry (runtime/events.py; zero with the plane
     # unarmed, the default): decision events recorded in the job's
     # journal and watchdog alerts raised. JOB-level counts mirrored into
@@ -175,6 +187,8 @@ class Statistics:
         active_version: Optional[int] = None,
         rescales_performed: int = 0,
         fleet_processes: int = 0,
+        fleet_degraded: int = 0,
+        blackbox_write_errors: int = 0,
         codec_encode_seconds: float = 0.0,
         codec_decode_seconds: float = 0.0,
         events_recorded: int = 0,
@@ -211,6 +225,10 @@ class Statistics:
             self.active_version = active_version
         self.rescales_performed += rescales_performed
         self.fleet_processes = max(self.fleet_processes, fleet_processes)
+        self.fleet_degraded = max(self.fleet_degraded, fleet_degraded)
+        self.blackbox_write_errors = max(
+            self.blackbox_write_errors, blackbox_write_errors
+        )
         self.codec_encode_seconds += codec_encode_seconds
         self.codec_decode_seconds += codec_decode_seconds
         # job-level mirrors (every fold carries the journal's current
@@ -313,6 +331,10 @@ class Statistics:
                 self.rescales_performed, other.rescales_performed
             ),
             fleet_processes=max(self.fleet_processes, other.fleet_processes),
+            fleet_degraded=max(self.fleet_degraded, other.fleet_degraded),
+            blackbox_write_errors=max(
+                self.blackbox_write_errors, other.blackbox_write_errors
+            ),
             events_recorded=max(
                 self.events_recorded, other.events_recorded
             ),
@@ -378,6 +400,8 @@ class Statistics:
             "activeVersion": self.active_version,
             "rescalesPerformed": self.rescales_performed,
             "fleetProcesses": self.fleet_processes,
+            "fleetDegraded": self.fleet_degraded,
+            "blackboxWriteErrors": self.blackbox_write_errors,
             "eventsRecorded": self.events_recorded,
             "alertsRaised": self.alerts_raised,
             "codecEncodeSeconds": self.codec_encode_seconds,

@@ -290,6 +290,25 @@ class CheckpointManager:
 
     # --- restore ---
 
+    def candidate_paths(self) -> List[str]:
+        """Every snapshot in the directory, NEWEST first (file names sort
+        chronologically). The recovery path walks this list when the
+        newest generation fails to load — a torn/corrupted pickle falls
+        back to the previous surviving generation instead of being the
+        only snapshot ever tried (``recover_job``)."""
+        try:
+            names = sorted(
+                (
+                    f
+                    for f in os.listdir(self.directory)
+                    if f.startswith("ckpt_") and f.endswith(".pkl")
+                ),
+                reverse=True,
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.directory, f) for f in names]
+
     def latest_path(self) -> Optional[str]:
         pointer = os.path.join(self.directory, "latest")
         if not os.path.exists(pointer):

@@ -63,6 +63,12 @@ class DeadLetterSink:
         self.by_reason: Dict[str, int] = {}
         self._fh = None
         self._file_failed = False
+        #: entries the quarantine FILE refused (ENOSPC, permissions):
+        #: quarantine degrades to the in-memory ring and counts the drop
+        #: instead of raising on the data path (folded into the
+        #: ``blackboxWriteErrors`` statistic alongside the black-box ring
+        #: and heartbeat writers' drop counters)
+        self.write_errors = 0
         #: flight-recorder journal (runtime/events.EventJournal), wired by
         #: the job when the recorder is armed: each quarantine entry then
         #: carries the journal's current high-water event id (``eventId``)
@@ -138,6 +144,7 @@ class DeadLetterSink:
             self._fh.flush()
         except OSError as exc:
             # degrade to in-memory only, once, loudly
+            self.write_errors += 1
             self._file_failed = True
             print(
                 f"warning: dead-letter file {self.path!r} unwritable "

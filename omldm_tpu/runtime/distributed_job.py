@@ -206,14 +206,30 @@ def _atomic_write_json(path: str, obj: Any) -> None:
     _atomic_write_bytes(path, json.dumps(obj).encode("utf-8"))
 
 
-def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> None:
+def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> str:
+    import hashlib
     import io
 
     buf = io.BytesIO()
     np.savez(buf, **arrays)
+    data = buf.getvalue()
     # through the fsync'd writer: the checkpoint barrier orders the
-    # LATEST flip after these writes, but durability needs the fsync
-    _atomic_write_bytes(path, buf.getvalue())
+    # LATEST flip after these writes, but durability needs the fsync.
+    # The sha256 of the bytes-as-written is returned so the snapshot
+    # metadata can pin every file's content — restore verifies the
+    # digests before trusting (or even loading) a generation.
+    _atomic_write_bytes(path, data)
+    return hashlib.sha256(data).hexdigest()
+
+
+def _file_sha256(path: str) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 class _DistPipeline:
@@ -329,6 +345,18 @@ class DistributedStreamJob:
         # an unsupervised manual rescale-restore self-increments instead
         self.rescales_performed = 0
         self._rescale_count_pinned = False
+        # self-healing fleet telemetry (runtime/selfheal.py): how many
+        # process slots the supervisor has shrunk away from the configured
+        # width (--fleetDegraded, authoritative; 0 = full width), and the
+        # count of telemetry writes (heartbeat files, black-box ring
+        # dumps) the disk refused — survived as a dropped-write counter
+        # instead of a dead worker (blackboxWriteErrors)
+        self.fleet_degraded = 0
+        self.hb_write_errors = 0
+        # collective hang watchdog (--collectiveTimeoutMs; None = unarmed,
+        # zero objects): a worker stuck in a fabric collective whose peer
+        # died dumps its black box and exits HANG_EXIT instead of wedging
+        self.watchdog = None
         self._ckpt_seq = 0
         self._reduce_jits: Dict[Tuple[str, int], Any] = {}
         self._loss_mean_jit = None
@@ -371,6 +399,50 @@ class DistributedStreamJob:
         """Flight-recorder hook: one attribute read when unarmed."""
         if self.events is not None:
             self.events.record(kind, cause, **fields)
+
+    # --- hang safety (runtime/selfheal.HangWatchdog) ---
+
+    def hang_guard(self, phase: str):
+        """Deadline guard around a collective-bearing region: re-entrant,
+        refreshed on every entry. The no-op context when the watchdog is
+        unarmed (the default)."""
+        if self.watchdog is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        return self.watchdog.guard(phase)
+
+    def arm_hang_watchdog(
+        self, timeout_s: float, warmup_s: Optional[float] = None
+    ) -> None:
+        """Arm the collective watchdog: a guarded region that makes no
+        progress for ``timeout_s`` (first entry per phase: ``warmup_s``,
+        the cold-compile allowance) dumps this process's black box and
+        exits :data:`~omldm_tpu.runtime.selfheal.HANG_EXIT` — the
+        reason-coded "my peer is wedged" exit the supervisor blames on
+        the SILENT process, not on this honest survivor."""
+        from omldm_tpu.runtime.selfheal import HANG_EXIT, HangWatchdog
+
+        def on_expire(phase: str) -> None:
+            self._warn(
+                f"collective watchdog: no progress in {phase!r} for "
+                f"{timeout_s * 1000.0:.0f}ms — a peer is dead or wedged; "
+                f"dumping black box and exiting HANG_EXIT({HANG_EXIT}) "
+                "instead of blocking forever"
+            )
+            if self.events is not None:
+                from omldm_tpu.runtime.events import HANG
+
+                self.events.record(
+                    HANG, "collective_timeout", phase=phase,
+                    timeout_ms=timeout_s * 1000.0,
+                )
+                self.events.incident("hang")
+            os._exit(HANG_EXIT)
+
+        self.watchdog = HangWatchdog(
+            timeout_s, on_expire, warmup_s=warmup_s
+        )
 
     def note_event_records(self, n: int) -> None:
         """Advance the journal's count clock (records consumed this
@@ -482,7 +554,10 @@ class DistributedStreamJob:
             )
             fn = jax.jit(reduce, out_shardings=rep)
             self._reduce_jits[(op, k)] = fn
-        return self._fetch_replicated(fn(arr))
+        # every completed reduce is fleet progress: the (re-entrant) guard
+        # entry refreshes any outer phase's hang deadline
+        with self.hang_guard("reduce"):
+            return self._fetch_replicated(fn(arr))
 
     def _agree_rounds(self, local_rounds: int) -> int:
         """All processes take the MAX of their desired round counts over
@@ -542,6 +617,12 @@ class DistributedStreamJob:
         Create/Update deploy, Delete tears down, Query answers collectively;
         anything invalid or unsupported is LOGGED and dropped, never
         silently ignored (PipelineMap.scala:34,46 prints and drops)."""
+        with self.hang_guard("control"):
+            self._sync_requests_guarded(lines)
+
+    def _sync_requests_guarded(
+        self, lines: Optional[List[str]] = None
+    ) -> None:
         for line in self._broadcast_lines(list(lines or [])):
             request = Request.from_json(line)
             if request is None:
@@ -671,6 +752,11 @@ class DistributedStreamJob:
             self.dp_local * self.config.batch_size,
             sparse=sparse, max_nnz=max_nnz,
         )
+        if self.watchdog is not None:
+            # a fresh pipeline means fresh XLA compiles in already-warmed
+            # phases: re-grant the cold-compile allowance so the hang
+            # watchdog does not shoot an honestly-compiling worker
+            self.watchdog.rewarm()
 
     # --- data path: this process's partition only ---
 
@@ -781,10 +867,11 @@ class DistributedStreamJob:
         drains remainders with zero-masked padding). Pipelines are visited
         in sorted id order so every process issues the same collective
         sequence."""
-        for net_id in sorted(self.pipelines):
-            p = self.pipelines[net_id]
-            self._pump_pipeline(p, final)
-            self._pump_forecasts(p)
+        with self.hang_guard("pump"):
+            for net_id in sorted(self.pipelines):
+                p = self.pipelines[net_id]
+                self._pump_pipeline(p, final)
+                self._pump_forecasts(p)
 
     def _pump_pipeline(self, p: _DistPipeline, final: bool) -> None:
         cap = p.stage_cap
@@ -1033,25 +1120,28 @@ class DistributedStreamJob:
         keeps feeding its slowest workers); a livelock guard backstops
         pathological streams."""
         self.pump(final=True)
-        for net_id in sorted(self.pipelines):
-            p = self.pipelines[net_id]
-            guard = 0
-            while self._agree_rounds(1 if p.pend_n else 0):
-                before = p.pend_n
-                self._pump_pipeline(p, final=True)
-                progressed = 1 if p.pend_n < before else 0
-                if not self._agree_rounds(progressed):
-                    # NOBODY advanced: a dried-up partition pins the
-                    # staleness bound (its worker's clock cannot move) —
-                    # apply the termination-time release, exactly the host
-                    # plane's SSPParameterServer.on_terminate semantics
-                    p.trainer.release_stragglers()
-                guard += 1
-                if guard > 1000:
-                    raise RuntimeError(
-                        "SSP drain made no progress requeuing refused rows"
-                    )
-            self._pump_forecasts(p)
+        with self.hang_guard("flush"):
+            for net_id in sorted(self.pipelines):
+                p = self.pipelines[net_id]
+                guard = 0
+                while self._agree_rounds(1 if p.pend_n else 0):
+                    before = p.pend_n
+                    self._pump_pipeline(p, final=True)
+                    progressed = 1 if p.pend_n < before else 0
+                    if not self._agree_rounds(progressed):
+                        # NOBODY advanced: a dried-up partition pins the
+                        # staleness bound (its worker's clock cannot move)
+                        # — apply the termination-time release, exactly the
+                        # host plane's SSPParameterServer.on_terminate
+                        # semantics
+                        p.trainer.release_stragglers()
+                    guard += 1
+                    if guard > 1000:
+                        raise RuntimeError(
+                            "SSP drain made no progress requeuing refused "
+                            "rows"
+                        )
+                self._pump_forecasts(p)
 
     # --- queries ---
 
@@ -1278,6 +1368,13 @@ class DistributedStreamJob:
             # state has been carried across, and the CURRENT fleet width
             rescales_performed=self.rescales_performed,
             fleet_processes=self.nproc,
+            # self-healing telemetry: slots shrunk away from the
+            # configured width (supervisor-pinned gauge) and telemetry
+            # writes the disk refused (heartbeats + black-box dumps)
+            fleet_degraded=self.fleet_degraded,
+            blackbox_write_errors=self.hb_write_errors + (
+                self.events.write_errors if self.events is not None else 0
+            ),
         )
         return stats, int(round(reduced[1]))
 
@@ -1291,12 +1388,13 @@ class DistributedStreamJob:
         entries = []
         holdout = {}
         requeued_local = 0
-        for net_id in sorted(self.pipelines):
-            p = self.pipelines[net_id]
-            stats, hold = self.pipeline_statistics(p)
-            entries.append(stats)
-            holdout[str(net_id)] = hold
-            requeued_local += getattr(p.trainer, "requeued_rows", 0)
+        with self.hang_guard("report"):
+            for net_id in sorted(self.pipelines):
+                p = self.pipelines[net_id]
+                stats, hold = self.pipeline_statistics(p)
+                entries.append(stats)
+                holdout[str(net_id)] = hold
+                requeued_local += getattr(p.trainer, "requeued_rows", 0)
         if self.pid != 0:
             return None
         report = JobStatistics(
@@ -1310,6 +1408,9 @@ class DistributedStreamJob:
         # read the job header without walking statistics rows)
         report["fleetProcesses"] = self.nproc
         report["rescalesPerformed"] = self.rescales_performed
+        # self-healing: slots currently shrunk away from the configured
+        # width (0 = full width; supervisor-pinned via --fleetDegraded)
+        report["fleetDegraded"] = self.fleet_degraded
         report["holdout"] = holdout
         # LOCAL count (process 0's workers): >0 proves the SSP requeue
         # path executed in this run
@@ -1331,7 +1432,15 @@ class DistributedStreamJob:
 
         The pointer flip happens only after a fabric barrier confirms every
         process's files are durable — the atomic-commit role of a Flink
-        checkpoint barrier's acknowledgement."""
+        checkpoint barrier's acknowledgement. Every file's sha256 is
+        recorded (fleet files in the manifest, each proc shard in its own
+        cursor meta) so restore can verify a generation's INTEGRITY before
+        trusting it — a torn/corrupted file fails the digest and the fleet
+        falls back to the previous surviving generation."""
+        with self.hang_guard("checkpoint"):
+            return self._save_checkpoint_guarded(root, cursor)
+
+    def _save_checkpoint_guarded(self, root: str, cursor: Any) -> str:
         import jax
 
         k = self._ckpt_seq
@@ -1341,6 +1450,7 @@ class DistributedStreamJob:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = NamedSharding(self.mesh, P())
+        fleet_digests: Dict[str, str] = {}
         for net_id in sorted(self.pipelines):
             p = self.pipelines[net_id]
             if p._gather_state_jit is None:
@@ -1357,7 +1467,7 @@ class DistributedStreamJob:
                     self._fetch_replicated(l)
                     for l in jax.tree_util.tree_leaves(gathered)
                 ]
-                _atomic_savez(
+                fleet_digests[f"fleet_{net_id}.npz"] = _atomic_savez(
                     os.path.join(d, f"fleet_{net_id}.npz"),
                     {f"leaf_{i}": l for i, l in enumerate(leaves)},
                 )
@@ -1430,7 +1540,11 @@ class DistributedStreamJob:
                 "curve": p.curve,
                 "global_rows": p.global_rows,
             }
-        _atomic_savez(os.path.join(d, f"proc{self.pid}.npz"), arrays)
+        # the shard digest rides in the shard's OWN meta (each process
+        # writes only its own files; the manifest carries proc 0's)
+        meta["sha256"] = _atomic_savez(
+            os.path.join(d, f"proc{self.pid}.npz"), arrays
+        )
         _atomic_write_json(os.path.join(d, f"proc{self.pid}.json"), meta)
         if self.pid == 0:
             _atomic_write_json(
@@ -1443,6 +1557,10 @@ class DistributedStreamJob:
                         self.pipelines[i].raw_line
                         for i in sorted(self.pipelines)
                     ],
+                    # per-file integrity digests (restore verifies before
+                    # trusting the generation; proc shards carry theirs
+                    # in their own cursor metas)
+                    "digests": fleet_digests,
                 },
             )
         self.barrier()  # every process's files durable before the flip
@@ -1510,10 +1628,16 @@ class DistributedStreamJob:
             if old_n != self.nproc and not self.rescale_restore:
                 return manifest
             # cursor metas of EVERY old process (the Kafka offset union
-            # needs them all; cheap JSON reads)
+            # needs them all; cheap JSON reads) — each carries its own
+            # shard's sha256
+            shard_digests: Dict[str, Any] = {}
             for q in range(old_n):
                 with open(os.path.join(d, f"proc{q}.json")) as f:
-                    json.load(f)
+                    shard_digests[f"proc{q}.npz"] = json.load(f).get(
+                        "sha256"
+                    )
+            digests = dict(manifest.get("digests") or {})
+            digests.update(shard_digests)
             paths = [
                 os.path.join(d, f"proc{q}.npz")
                 for q in rescale_shard_map(old_n, self.nproc, self.pid)
@@ -1521,6 +1645,15 @@ class DistributedStreamJob:
                 os.path.join(d, f"fleet_{net_id}.npz") for net_id in net_ids
             ]
             for path in paths:
+                # integrity first: a recorded digest must match the bytes
+                # on disk EXACTLY (catches corruptions np.load would
+                # happily half-decode); snapshots from before the digest
+                # era (no recorded digest) fall through to the load check
+                recorded = digests.get(os.path.basename(path))
+                if recorded and _file_sha256(path) != recorded:
+                    raise ValueError(
+                        f"sha256 mismatch on {os.path.basename(path)}"
+                    )
                 with np.load(path) as z:
                     for key in z.files:
                         _ = z[key]
@@ -1529,6 +1662,15 @@ class DistributedStreamJob:
             self._warn(
                 f"snapshot {os.path.basename(d)} failed validation: "
                 f"{type(exc).__name__}: {exc}"
+            )
+            from omldm_tpu.runtime.events import RESTORE
+
+            # reason-coded restore decision: this generation is untrusted
+            # and the fleet will fall back to the previous surviving one
+            self._record_event(
+                RESTORE, "candidate_rejected",
+                snapshot=os.path.basename(d),
+                error=f"{type(exc).__name__}: {exc}",
             )
             return None
 
@@ -1579,6 +1721,10 @@ class DistributedStreamJob:
         previous complete one; the LATEST pointer is repointed and the
         unusable snapshots pruned so later incarnations never retry
         them."""
+        with self.hang_guard("restore"):
+            return self._restore_checkpoint_guarded(root)
+
+    def _restore_checkpoint_guarded(self, root: str) -> Optional[Any]:
         import jax
 
         latest = os.path.join(root, "LATEST")
@@ -1865,7 +2011,7 @@ def _flag_true(flags: Dict[str, str], key: str) -> bool:
     return flags.get(key, "").lower() in ("true", "1", "yes")
 
 
-def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> None:
+def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> bool:
     """Touch this process's heartbeat file (the supervisor's liveness
     channel). Called at every synchronized pump point, so a process wedged
     in a collective (peer died) stops beating and gets detected. The file
@@ -1875,10 +2021,12 @@ def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> None:
     host-plane signals (``serveP99``/``imbalance``/``backlog``) the
     autoscaling supervisor folds across the fleet
     (supervisor._beat_frame; a bare int ``frame`` writes the legacy
-    two-token form). Absent/zero when the overload plane is unarmed."""
+    two-token form). Absent/zero when the overload plane is unarmed.
+    Returns False when the disk refused the write (ENOSPC survival: the
+    caller counts the dropped beat, the worker keeps running)."""
     d = flags.get("heartbeatDir")
     if not d:
-        return
+        return True
     if isinstance(frame, dict):
         level = int(frame.get("level", 0))
         tail = "".join(
@@ -1898,8 +2046,9 @@ def _heartbeat(flags: Dict[str, str], pid: int, frame=0) -> None:
         with open(path + ".tmp", "w") as f:
             f.write(f"{time.time()} {level}{tail}")
         os.replace(path + ".tmp", path)
+        return True
     except OSError:
-        pass  # a full/odd disk must not kill the job over telemetry
+        return False  # a full/odd disk must not kill the job over telemetry
 
 
 def _maybe_rescale_exit(
@@ -1960,7 +2109,12 @@ def _maybe_rescale_exit(
 def _make_injector(job: DistributedStreamJob, flags: Dict[str, str]):
     from omldm_tpu.runtime.supervisor import DistributedFaultInjector
 
-    return DistributedFaultInjector(flags, job.pid)
+    injector = DistributedFaultInjector(flags, job.pid)
+    # launch-refusal fault: fires HERE, before this process's first
+    # heartbeat, so the supervisor's classifier sees a worker that died
+    # without ever coming up (the LAUNCH class)
+    injector.on_launch()
+    return injector
 
 
 def _sync_requests_from_flags(
@@ -1996,7 +2150,10 @@ def _chunk_tick(
     crashes fire here too, so a kill lands at one well-defined cut (the
     supervisor then relaunches the fleet with --restore, Flink's
     global-restart strategy)."""
-    _heartbeat(flags, job.pid, job.heartbeat_frame())
+    if not _heartbeat(flags, job.pid, job.heartbeat_frame()):
+        # dropped-write counter, not a dead worker (ENOSPC survival);
+        # surfaces as blackboxWriteErrors in the job report
+        job.hb_write_errors += 1
     job.note_event_records(records)
     if job.events is not None and job.events.dirty:
         # dump-on-dirty: decision events are rare on this engine, so the
@@ -2631,6 +2788,20 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
     if "rescaleCount" in flags:
         job.rescales_performed = int(flags["rescaleCount"] or 0)
         job._rescale_count_pinned = True
+    # self-healing knobs: the supervisor pins the degraded-width gauge
+    # (--fleetDegraded) and --collectiveTimeoutMs arms the hang watchdog
+    # (first guard entry per phase gets the --collectiveWarmupMs
+    # allowance for cold XLA compiles). Unset = zero watchdog objects,
+    # exact pre-PR routes.
+    if "fleetDegraded" in flags:
+        job.fleet_degraded = int(flags["fleetDegraded"] or 0)
+    hang_ms = float(flags.get("collectiveTimeoutMs", "0") or 0)
+    if hang_ms > 0:
+        job.arm_hang_watchdog(
+            hang_ms / 1000.0,
+            warmup_s=float(flags.get("collectiveWarmupMs", "120000"))
+            / 1000.0,
+        )
     # process 0 reads the request file; everyone else receives the
     # broadcast (passing lines from a non-0 process is ignored). On a
     # restore the manifest redeploys the pipeline map instead — the
@@ -2792,6 +2963,10 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
 
         job.events.record(TERMINATE, "drive_complete")
         job.events.dump()
+    if job.watchdog is not None:
+        # the collectives are done: a slow final file write must not be
+        # mistaken for a wedged fabric
+        job.watchdog.stop()
     return 0
 
 

@@ -86,6 +86,12 @@ RESCALE = "rescale"                  # parallelism change decided/agreed
 RESTORE = "restore"                  # checkpoint-restore decision
 RESTART = "restart"                  # supervisor restart decision
 SCALE = "scale"                      # autoscale decision signaled
+# self-healing fleet (runtime/selfheal.py, runtime/supervisor.py,
+# runtime/distributed_job.py)
+STRIKE = "strike"                    # classified failure charged to a slot
+DEGRADE = "degrade"                  # shrink-to-survivors decided
+PROBE = "probe"                      # re-expansion probe signaled/settled
+HANG = "hang"                        # worker hang-watchdog fired (HANG_EXIT)
 # recorder-internal
 ALERT = "alert"                      # watchdog rule fired
 ALERT_CLEAR = "alert_clear"          # watchdog rule cleared (hysteresis)
@@ -305,6 +311,11 @@ class EventJournal:
         self.alerts = 0         # ALERT events ever recorded
         self.by_kind: Dict[str, int] = {}
         self.dumps_written = 0
+        # ring dumps the disk refused (ENOSPC, permissions, a yanked
+        # volume): the black box degrades to the in-memory ring and
+        # COUNTS the drop instead of raising on the data path — the
+        # counter surfaces as ``blackboxWriteErrors`` in Statistics
+        self.write_errors = 0
         self._dirty = False     # events since the last dump
         # transport-stream incarnation: a LIVE rescale restarts the
         # per-net sequence counters (reused worker slots count from 0
@@ -407,6 +418,7 @@ class EventJournal:
                 "".join(json.dumps(e) + "\n" for e in self.events),
             )
         except OSError:
+            self.write_errors += 1
             return None
         self.dumps_written += 1
         self._dirty = False
@@ -811,6 +823,7 @@ __all__ = [
     "ALERT",
     "ALERT_CLEAR",
     "CHANNEL_RESYNC",
+    "DEGRADE",
     "DELTA_REJECTED",
     "EventJournal",
     "EventsConfig",
@@ -819,10 +832,12 @@ __all__ = [
     "GUARD_EVICT",
     "GUARD_ROLLBACK",
     "GUARD_TRIP",
+    "HANG",
     "INCIDENT_DUMP",
     "LIFECYCLE",
     "PAUSE",
     "PRESSURE",
+    "PROBE",
     "QUORUM_RELEASE",
     "RESCALE",
     "RESTART",
@@ -830,6 +845,7 @@ __all__ = [
     "RESYNC",
     "SCALE",
     "SHED",
+    "STRIKE",
     "TERMINATE",
     "THROTTLE",
     "Watchdog",

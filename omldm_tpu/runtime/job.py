@@ -610,8 +610,21 @@ class StreamJob:
                     events_recorded=self.events.journal.total,
                     alerts_raised=self.events.journal.alerts,
                 )
+            nw = self._blackbox_write_errors()
+            if nw:
+                s.update_stats(blackbox_write_errors=nw)
             out.append(s)
         return out
+
+    def _blackbox_write_errors(self) -> int:
+        """Telemetry/quarantine writes the disk refused (black-box ring
+        dumps + dead-letter file appends): survived as a dropped-write
+        counter, mirrored job-wide like events_recorded (max-combine, so
+        the heartbeat peek + terminate fold cannot double-count)."""
+        n = self.dead_letter.write_errors
+        if self.events is not None:
+            n += self.events.journal.write_errors
+        return n
 
     def _emit_heartbeat(self, now: Optional[float] = None) -> None:
         """One incremental JobStatistics snapshot through the existing
@@ -1421,6 +1434,7 @@ class StreamJob:
             self.events.journal.record(TERMINATE, "termination_protocol")
             ne = self.events.journal.total
             na = self.events.journal.alerts
+        nw = self._blackbox_write_errors()
         for bridge in self.spmd_bridges.values():
             bridge.handle_terminate_probe()
             bridge_stats = bridge.network_statistics()
@@ -1433,6 +1447,8 @@ class StreamJob:
                     bridge_stats.update_stats(
                         events_recorded=ne, alerts_raised=na
                     )
+                if nw:
+                    bridge_stats.update_stats(blackbox_write_errors=nw)
             self.stats.add_hub_statistics(bridge.request.id, bridge_stats)
         self.hub_manager.on_terminate()
         for net_id in self.pipeline_manager.live_pipelines:
@@ -1449,6 +1465,8 @@ class StreamJob:
                     merged.update_stats(
                         events_recorded=ne, alerts_raised=na
                     )
+                if nw:
+                    merged.update_stats(blackbox_write_errors=nw)
                 merged.normalize(
                     max(
                         len(

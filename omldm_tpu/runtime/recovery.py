@@ -54,6 +54,10 @@ class FailureRecord:
     error: str
     at: float
     restored_from: Optional[str] = None  # checkpoint path, None = fresh
+    # failure class (runtime/selfheal.classify_exception): "crash" |
+    # "hang" (timeout shape) | "launch" (died before processing a single
+    # event of the attempt — the in-process form of "never heartbeat")
+    kind: str = "crash"
 
 
 def skip_events(events: Iterable[Event], n: int) -> Iterator[Event]:
@@ -68,20 +72,65 @@ def skip_events(events: Iterable[Event], n: int) -> Iterator[Event]:
     yield from it
 
 
+def _record_restore(job: StreamJob, cause: str, **fields) -> None:
+    """Reason-coded restore-decision event on the (armed) flight
+    recorder; a no-op otherwise — restore decisions must read in the
+    incident bundle either way they go."""
+    rec = getattr(job, "events", None)
+    if rec is not None:
+        from omldm_tpu.runtime.events import RESTORE
+
+        rec.journal.record(RESTORE, cause, **fields)
+
+
 def recover_job(
     failed: StreamJob, ckpt_floor: Optional[str] = None
 ) -> Tuple[StreamJob, Optional[str]]:
-    """Build a failed job's next incarnation: restore the latest checkpoint
-    newer than ``ckpt_floor`` (pre-existing snapshots from an earlier run
-    are never restored), else a fresh job from the original config. Sinks
-    carry over. Returns (job, restored_from_path_or_None)."""
+    """Build a failed job's next incarnation: restore the newest USABLE
+    checkpoint newer than ``ckpt_floor`` (pre-existing snapshots from an
+    earlier run are never restored), else a fresh job from the original
+    config. A generation that fails to load — torn pickle, truncated
+    file, unreadable disk — falls back to the previous surviving one
+    instead of crashing the supervisor or silently starting fresh while
+    older good snapshots exist; each decision is reason-coded onto the
+    failed job's flight recorder when armed. Sinks carry over. Returns
+    (job, restored_from_path_or_None)."""
+    import os as _os
+    import sys as _sys
+
     manager = failed.checkpoint_manager
-    path = manager.latest_path() if manager is not None else None
-    if path == ckpt_floor:
-        path = None  # pre-existing snapshot from an earlier run
-    if path is not None:
-        job = manager.restore(path=path)
+    floor_name = _os.path.basename(ckpt_floor) if ckpt_floor else ""
+    job: Optional[StreamJob] = None
+    path: Optional[str] = None
+    if manager is not None:
+        for candidate in manager.candidate_paths():
+            # names sort chronologically: at/below the floor = a snapshot
+            # from an earlier run in a reused directory, never restored
+            if floor_name and _os.path.basename(candidate) <= floor_name:
+                break
+            try:
+                job = manager.restore(path=candidate)
+                path = candidate
+                break
+            except Exception as exc:
+                print(
+                    f"warning: checkpoint {_os.path.basename(candidate)} "
+                    f"failed to restore ({type(exc).__name__}: {exc}); "
+                    "falling back to the previous generation",
+                    file=_sys.stderr,
+                )
+                _record_restore(
+                    failed, "candidate_rejected",
+                    snapshot=_os.path.basename(candidate),
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+    if job is not None:
+        _record_restore(
+            failed, "snapshot", snapshot=_os.path.basename(path)
+        )
     else:
+        if manager is not None:
+            _record_restore(failed, "no_usable_snapshot")
         job = StreamJob(copy.deepcopy(failed.config))
     job.set_sinks(
         on_prediction=failed._on_prediction,
@@ -120,6 +169,8 @@ class JobSupervisor:
         restart_delay_s: float = 0.0,
         on_failure: Optional[Callable[[FailureRecord], None]] = None,
         restart_jitter_s: float = 0.0,
+        restart_growth: float = 2.0,
+        restart_seed: Optional[int] = None,
     ):
         self.job = job
         self.source_factory = source_factory
@@ -127,6 +178,14 @@ class JobSupervisor:
         self.restart_delay_s = restart_delay_s
         self.restart_jitter_s = restart_jitter_s
         self.on_failure = on_failure
+        # the restart policy is shared with the distributed supervisor
+        # (runtime/selfheal.RestartPolicy): exponential backoff (growth
+        # 1.0 recovers the reference's fixed delay exactly) with seeded
+        # jitter — in-process and fleet supervision restart with the same
+        # vocabulary. Derived from the attributes at run() time so
+        # pre-run mutation keeps working.
+        self.restart_growth = restart_growth
+        self.restart_seed = restart_seed
         self.failures: List[FailureRecord] = []
         # only checkpoints taken DURING this supervised run are restore
         # candidates: a stale snapshot left in a reused checkpoint directory
@@ -168,16 +227,24 @@ class JobSupervisor:
 
         def attempt() -> Optional[JobStatistics]:
             job = self.job
+            start_offset = job.events_processed
             try:
                 return job.run(
                     self.source_factory(job.events_processed),
                     terminate_on_end=terminate_on_end,
                 )
             except Exception as exc:  # any escape is a detected job failure
+                from omldm_tpu.runtime.selfheal import classify_exception
+
                 self.failures.append(FailureRecord(
                     offset=job.events_processed,
                     error=f"{type(exc).__name__}: {exc}",
                     at=time.time(),
+                    # classified like the fleet's: an attempt that died
+                    # before processing a single event is the launch class
+                    kind=classify_exception(
+                        exc, progressed=job.events_processed > start_offset
+                    ),
                 ))
                 raise
 
@@ -187,18 +254,26 @@ class JobSupervisor:
             if self.on_failure is not None:
                 self.on_failure(record)
 
-        # Flink's fixed-delay restart strategy through the one shared
-        # backoff implementation: max_restarts retries at a constant delay
-        # (+ optional jitter so a fleet of supervised jobs desynchronizes)
+        # the shared RestartPolicy (runtime/selfheal.py): exponential
+        # backoff with seeded jitter through the one backoff
+        # implementation — growth 1.0 recovers Flink's fixed-delay
+        # strategy exactly
+        from omldm_tpu.runtime.selfheal import RestartPolicy
+
+        restart_policy = RestartPolicy(
+            max_restarts=self.max_restarts,
+            base_delay_s=self.restart_delay_s,
+            growth=self.restart_growth,
+            jitter_s=self.restart_jitter_s,
+            seed=self.restart_seed,
+        )
         try:
             return with_backoff(
                 attempt,
-                attempts=self.max_restarts + 1,
-                base_delay=self.restart_delay_s,
-                growth=1.0,
-                jitter=self.restart_jitter_s,
+                policy=restart_policy.backoff(),
                 retry_on=(Exception,),
                 on_retry=on_retry,
+                rng=restart_policy.rng(),
             )
         finally:
             # one merged incident bundle per supervised run: every failed
@@ -249,6 +324,7 @@ class JobSupervisor:
                 RESTART, "worker_failure", error=record.error,
                 offset=record.offset, attempt=len(self.failures),
                 restored_from=record.restored_from,
+                failure_kind=record.kind,
             )
         return job
 

@@ -1,0 +1,509 @@
+"""Self-healing fleet policy layer: failure classification, slot strikes,
+shrink-to-survivors, probed re-expansion, hang watchdogs, kill escalation.
+
+Reference counterpart: the reference's entire failure story is crash-stop —
+``JobTerminator.scala:6-10`` throws and Flink restarts the WHOLE job at
+fixed parallelism with a fixed-delay strategy (Job.scala:14). One
+permanently-bad slot (un-launchable process, repeated OOM, a worker wedged
+inside a collective) therefore burns the restart budget until the job dies.
+This module is the pure, unit-testable policy half of the self-healing
+supervisor (ISSUE 15): the supervisors in ``runtime/supervisor.py`` and
+``runtime/recovery.py`` consume it, the distributed workers arm its hang
+watchdog, and ``tests/test_selfheal.py`` drives every transition with an
+injectable clock.
+
+Layers:
+
+- :func:`classify_failure` / :func:`classify_exception` — the failure
+  taxonomy. Every fleet failure is one of ``crash`` (a nonzero exit from a
+  process that had proven itself alive), ``hang`` (heartbeat silence, or a
+  survivor's reason-coded :data:`HANG_EXIT` blaming a wedged peer), or
+  ``launch`` (a process that died without ever heartbeating — it never
+  came up at all). The classes matter because the right reaction differs:
+  a crash restarts, a hang needs the wedged slot killed and blamed, a
+  launch failure will almost certainly repeat.
+- :class:`SelfHealPolicy` — per-slot strike accounting with a
+  strike/degrade/probe state machine. ``strike_threshold`` consecutive
+  failures blamed on the same slot DEGRADE the fleet to the survivors
+  (``N - |bad|``, floored at ``min_processes``) through the existing
+  restore-with-rescale path; while degraded, a periodic PROBE signals the
+  fleet back toward the configured width, and a probe that stays healthy
+  for ``probe_window_s`` clears the strikes while a failed probe
+  re-degrades immediately.
+- :class:`HangWatchdog` — the worker-side deadline around fabric
+  collectives: a worker stuck waiting on a killed/SIGSTOP'd peer dumps its
+  black box and exits :data:`HANG_EXIT` instead of wedging forever.
+  Re-entrant guards refresh the deadline on every collective entry; the
+  first entry per phase gets the ``warmup`` allowance (cold XLA compiles
+  legitimately take longer than any sane collective timeout).
+- :func:`kill_escalate` — SIGTERM -> deadline -> SIGKILL so a SIGSTOP'd or
+  wedged process cannot stall the supervisor's own restart path (SIGTERM
+  is merely QUEUED for a stopped process; SIGKILL is not).
+- :class:`RestartPolicy` — the ONE restart policy object both supervisors
+  share: exponential backoff (Flink's fixed delay is ``growth=1``) with
+  DETERMINISTIC jitter (seeded, replayable — a fleet of supervisors
+  desynchronizes identically on every run).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from omldm_tpu.utils.backoff import BackoffPolicy, seeded_rng
+
+# --- failure taxonomy -------------------------------------------------------
+
+CRASH = "crash"    # nonzero exit after the process had proven itself alive
+HANG = "hang"      # heartbeat silence / wedged in a collective
+LAUNCH = "launch"  # died without ever heartbeating: never came up
+
+# exit code a worker's hang watchdog uses: "my peer is dead or wedged; I am
+# exiting instead of blocking in this collective forever". Distinct from
+# RESCALE_EXIT (17) and the fault injector's crash code (3) so the
+# supervisor can blame the WEDGED slot, not the honest survivor.
+HANG_EXIT = 19
+
+
+def classify_failure(
+    returncode: Optional[int] = None,
+    heartbeat_silent: bool = False,
+    ever_beat: Optional[bool] = None,
+) -> str:
+    """One failed slot's failure class. ``ever_beat`` is None when the
+    heartbeat channel is unarmed (launch failures are then
+    indistinguishable from crashes and classify as ``crash``)."""
+    if heartbeat_silent or returncode == HANG_EXIT:
+        return HANG
+    if ever_beat is False:
+        return LAUNCH
+    return CRASH
+
+
+def classify_exception(exc: BaseException, progressed: bool = True) -> str:
+    """The in-process twin (``recovery.JobSupervisor``): an attempt that
+    failed before processing a single event is the launch class ("never
+    came up"); a timeout shape is a hang; everything else is a crash."""
+    if isinstance(exc, TimeoutError):
+        return HANG
+    if not progressed:
+        return LAUNCH
+    return CRASH
+
+
+# --- restart policy ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """The shared restart policy: ``max_restarts`` relaunches with
+    exponential backoff (``base_delay_s * growth**k``) and deterministic
+    jitter (``U(0, jitter_s)`` drawn from a seeded stream — same seed,
+    same delays, every run). ``growth=1.0`` is the reference's
+    fixedDelayRestart; the supervisors default to 2.0 now so a
+    crash-looping fleet backs off instead of hammering a fixed cadence.
+
+    ``seed=None`` (the default) derives the stream from the supervisor's
+    pid: co-hosted supervisors still DESYNCHRONIZE (the whole point of
+    jitter — a shared fixed default would make every fleet's jitter
+    identical, a thundering-herd regression); an explicit seed pins the
+    schedule for replays and tests."""
+
+    max_restarts: int = 3
+    base_delay_s: float = 0.0
+    growth: float = 2.0
+    jitter_s: float = 0.0
+    seed: Optional[int] = None
+
+    def backoff(self) -> BackoffPolicy:
+        return BackoffPolicy(
+            attempts=self.max_restarts + 1,
+            base_delay=self.base_delay_s,
+            growth=self.growth,
+            jitter=self.jitter_s,
+        )
+
+    def rng(self) -> Callable[[], float]:
+        seed = self.seed if self.seed is not None else os.getpid()
+        return seeded_rng(seed, "restart")
+
+
+# --- slot strikes + degrade/probe state machine -----------------------------
+
+
+class SelfHealPolicy:
+    """Per-slot strike accounting and the degrade/probe state machine.
+
+    Pure and clock-injectable (no I/O, no processes): the supervisor feeds
+    it classified failures and poll ticks, it answers with target process
+    counts. State:
+
+    - FULL: the fleet runs at ``configured`` width. Each failure strikes
+      its blamed slots; a slot reaching ``strike_threshold`` CONSECUTIVE
+      strikes joins the bad set and :meth:`note_failure` returns the
+      shrink target ``nproc - |newly bad|`` (floored at
+      ``min_processes``). Strikes are per-slot-id and reset on any width
+      change (a shrink renumbers the survivors).
+    - DEGRADED (``degraded_by > 0``): after ``probe_after_s`` of degraded
+      running, :meth:`probe_target` answers the configured width — the
+      supervisor signals a restore-with-rescale back to full.
+    - PROBING: a failure inside the probe (before ``probe_window_s`` of
+      healthy running since the probe fleet spawned) RE-DEGRADES
+      immediately (no fresh strike budget for a slot that just proved
+      itself bad); ``probe_window_s`` of health HEALS — strikes and the
+      degraded width both clear.
+
+    ``strikes`` survives fleet restarts by living here, in the supervisor
+    process, not in any worker."""
+
+    def __init__(
+        self,
+        strike_threshold: int,
+        configured: int,
+        *,
+        min_processes: int = 1,
+        probe_after_s: float = 30.0,
+        probe_window_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if strike_threshold < 1:
+            raise ValueError(
+                f"slotStrikes must be >= 1, got {strike_threshold}"
+            )
+        if min_processes < 1:
+            raise ValueError(f"minProcesses must be >= 1, got {min_processes}")
+        if configured < min_processes:
+            raise ValueError(
+                f"configured width {configured} < minProcesses "
+                f"{min_processes}"
+            )
+        self.strike_threshold = strike_threshold
+        self.configured = configured
+        self.min_processes = min_processes
+        self.probe_after_s = probe_after_s
+        self.probe_window_s = probe_window_s
+        self._clock = clock
+        self.strikes: Dict[int, int] = {}
+        self.degraded_by = 0
+        self.probing = False
+        self._probe_spawned: Optional[float] = None
+        self._degraded_at: Optional[float] = None
+        # counters (observability; the supervisor mirrors them into its
+        # strike file and decision events)
+        self.degrades = 0
+        self.probes = 0
+        self.probe_failures = 0
+        self.heals = 0
+
+    # --- queries ---
+
+    @property
+    def degraded(self) -> bool:
+        return self.degraded_by > 0
+
+    def snapshot(self) -> dict:
+        """JSON-shaped state for the supervisor's strike file."""
+        return {
+            "strikes": {str(k): v for k, v in self.strikes.items()},
+            "degradedBy": self.degraded_by,
+            "probing": self.probing,
+            "degrades": self.degrades,
+            "probes": self.probes,
+            "probeFailures": self.probe_failures,
+            "heals": self.heals,
+        }
+
+    # --- transitions ---
+
+    def note_failure(
+        self,
+        slots: Sequence[int],
+        kinds: Optional[Dict[int, str]] = None,
+        nproc: Optional[int] = None,
+        now: Optional[float] = None,
+    ) -> Optional[int]:
+        """Charge a classified fleet failure to its blamed slots; returns
+        the process count to DEGRADE to, or None (restart at the current
+        width through the normal restart policy). ``kinds`` maps slot ->
+        failure class (recorded by the caller; the policy itself treats
+        every class the same — consecutive failures of one slot are the
+        signal, whatever their shape)."""
+        now = self._clock() if now is None else now
+        nproc = self.configured - self.degraded_by if nproc is None else nproc
+        if self.probing:
+            # a failure inside the probe window: the bad slot is still
+            # bad. Re-degrade immediately to the width that was healthy —
+            # no fresh strike budget, no restart attempt burned.
+            self.probing = False
+            self._probe_spawned = None
+            self.probe_failures += 1
+            self._degraded_at = now
+            target = max(self.configured - self.degraded_by,
+                         self.min_processes)
+            return target if target < nproc else None
+        if not slots:
+            return None
+        newly_bad: List[int] = []
+        for slot in slots:
+            self.strikes[slot] = self.strikes.get(slot, 0) + 1
+            if self.strikes[slot] >= self.strike_threshold:
+                newly_bad.append(slot)
+        if not newly_bad:
+            return None
+        target = max(nproc - len(newly_bad), self.min_processes)
+        if target >= nproc:
+            # already at the floor: nothing to shrink away; the restart
+            # policy (and ultimately its attempt budget) owns this slot
+            return None
+        self.degraded_by += nproc - target
+        self.degrades += 1
+        self._degraded_at = now
+        # the shrink renumbers every surviving slot: stale per-slot
+        # counts would blame the wrong survivors
+        self.strikes.clear()
+        return target
+
+    def note_healthy_attempt(self) -> None:
+        """A fleet attempt ran to clean completion: consecutive-failure
+        streaks are over."""
+        self.strikes.clear()
+
+    def probe_target(
+        self, nproc: int, now: Optional[float] = None
+    ) -> Optional[int]:
+        """The width to probe back toward, once the degraded fleet has run
+        quietly for ``probe_after_s`` — or None (hold)."""
+        now = self._clock() if now is None else now
+        if (
+            not self.degraded
+            or self.probing
+            or nproc >= self.configured
+            or self._degraded_at is None
+            or now - self._degraded_at < self.probe_after_s
+        ):
+            return None
+        return self.configured
+
+    def note_probe_signaled(self) -> None:
+        """The supervisor wrote the probe's rescale signal: the next
+        relaunch is the probe fleet."""
+        self.probing = True
+        self._probe_spawned = None
+        self.probes += 1
+
+    def note_spawn(self, now: Optional[float] = None) -> None:
+        """A fleet incarnation spawned; if it is the probe fleet, the
+        probe window clock starts here (not at signal time — checkpoint
+        + relaunch latency must not eat the window)."""
+        if self.probing and self._probe_spawned is None:
+            self._probe_spawned = self._clock() if now is None else now
+
+    def tick_healthy(self, now: Optional[float] = None) -> bool:
+        """Poll-loop tick while the fleet runs: True exactly once when a
+        probe has stayed healthy for ``probe_window_s`` — the HEAL
+        transition (strikes and the degraded width both clear)."""
+        if not self.probing or self._probe_spawned is None:
+            return False
+        now = self._clock() if now is None else now
+        if now - self._probe_spawned < self.probe_window_s:
+            return False
+        self.probing = False
+        self._probe_spawned = None
+        self.degraded_by = 0
+        self.strikes.clear()
+        self.heals += 1
+        return True
+
+
+# --- worker-side hang watchdog ----------------------------------------------
+
+
+class HangWatchdog:
+    """Deadline watchdog around fabric collectives.
+
+    A worker whose peer died mid-collective blocks in native code forever
+    (gloo keeps waiting); the supervisor's heartbeat channel eventually
+    notices the SILENT worker, but the honest survivors would wedge until
+    killed. This watchdog gives every guarded region a deadline: re-entrant
+    ``guard(phase)`` context managers refresh the deadline on entry (each
+    completed collective round is progress), and a poll thread fires
+    ``on_expire(phase)`` — the worker's reason-coded HANG_EXIT path — when
+    a region overstays ``timeout_s``.
+
+    The FIRST entry per phase uses ``warmup_s`` (default: ``timeout_s``):
+    cold XLA compiles legitimately dwarf any sane collective timeout, and a
+    watchdog that shoots a compiling worker would be the fault it exists
+    to contain. ``thread=False`` builds the deterministic unit-test form:
+    no thread, expiry checked by explicit :meth:`check` calls."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_expire: Callable[[str], None],
+        *,
+        warmup_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        thread: bool = True,
+        poll_s: Optional[float] = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.warmup_s = float(
+            warmup_s if warmup_s is not None else timeout_s
+        )
+        self.on_expire = on_expire
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._deadline: Optional[float] = None
+        self._phase: Optional[str] = None
+        self._warmed: set = set()
+        self.fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if thread:
+            self._thread = threading.Thread(
+                target=self._poll_loop,
+                args=(poll_s or max(min(self.timeout_s / 4.0, 0.25), 0.01),),
+                name="omldm-hang-watchdog",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _poll_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            self.check()
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """Fire ``on_expire`` (once) when the armed deadline has passed;
+        returns whether it fired."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self.fired or self._deadline is None or now < self._deadline:
+                return False
+            self.fired = True
+            phase = self._phase or "?"
+        # outside the lock: on_expire typically dumps files and _exits
+        self.on_expire(phase)
+        return True
+
+    def _arm(self, phase: str) -> None:
+        with self._lock:
+            self._depth += 1
+            allowance = self.timeout_s
+            if phase not in self._warmed:
+                self._warmed.add(phase)
+                allowance = max(self.warmup_s, self.timeout_s)
+            self._deadline = self._clock() + allowance
+            self._phase = phase
+
+    def _disarm(self) -> None:
+        with self._lock:
+            self._depth = max(self._depth - 1, 0)
+            if self._depth == 0:
+                self._deadline = None
+                self._phase = None
+
+    def guard(self, phase: str):
+        """Re-entrant deadline guard: every entry refreshes the deadline
+        (progress resets the clock); the deadline disarms when the
+        OUTERMOST guard exits."""
+        return _WatchdogGuard(self, phase)
+
+    def rewarm(self) -> None:
+        """Re-grant every phase its cold-compile allowance. Called when
+        something that legitimately recompiles lands mid-stream (a new
+        pipeline deployed by a Create) — a fresh multi-second XLA compile
+        inside an already-warmed phase must not read as a hang."""
+        with self._lock:
+            self._warmed.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._deadline = None
+
+
+class _WatchdogGuard:
+    __slots__ = ("_wd", "_phase")
+
+    def __init__(self, wd: HangWatchdog, phase: str):
+        self._wd = wd
+        self._phase = phase
+
+    def __enter__(self):
+        self._wd._arm(self._phase)
+        return self
+
+    def __exit__(self, *exc):
+        self._wd._disarm()
+        return False
+
+
+# --- supervisor-side kill escalation ----------------------------------------
+
+
+def kill_escalate(
+    procs: Sequence[Any],
+    term_deadline_s: float = 5.0,
+    *,
+    poll_s: float = 0.02,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> List[int]:
+    """Terminate a fleet: SIGTERM everyone, give the polite ones
+    ``term_deadline_s`` to exit, SIGKILL the stragglers, reap everything.
+    Returns the indices that needed the SIGKILL escalation.
+
+    The escalation is what makes the supervisor's restart path hang-safe:
+    SIGTERM is only QUEUED for a SIGSTOP'd process (it would stay stopped
+    forever), and a worker wedged in a native collective may never run its
+    signal handler — SIGKILL takes both down unconditionally. ``procs``
+    are ``subprocess.Popen``-shaped (poll/terminate/kill/wait)."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = clock() + max(term_deadline_s, 0.0)
+    escalated: List[int] = []
+    for i, p in enumerate(procs):
+        while p.poll() is None and clock() < deadline:
+            sleep(poll_s)
+        if p.poll() is None:
+            escalated.append(i)
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+    return escalated
+
+
+def sigstop_self() -> None:
+    """The hang fault injector's trigger: freeze THIS process the way a
+    livelocked/priority-inverted worker freezes — still alive (poll()
+    returns None), never beating, never exiting on its own."""
+    os.kill(os.getpid(), signal.SIGSTOP)
+
+
+__all__ = [
+    "CRASH",
+    "HANG",
+    "HANG_EXIT",
+    "LAUNCH",
+    "HangWatchdog",
+    "RestartPolicy",
+    "SelfHealPolicy",
+    "classify_exception",
+    "classify_failure",
+    "kill_escalate",
+    "seeded_rng",
+    "sigstop_self",
+]

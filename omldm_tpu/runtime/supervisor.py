@@ -61,6 +61,15 @@ import tempfile
 import time
 from typing import Dict, List, Optional, Sequence
 
+from omldm_tpu.runtime.selfheal import (
+    CRASH,
+    HANG,
+    HANG_EXIT,
+    RestartPolicy,
+    SelfHealPolicy,
+    classify_failure,
+    kill_escalate,
+)
 from omldm_tpu.utils.backoff import with_backoff
 
 # flags the supervisor consumes itself; everything else passes through to
@@ -86,6 +95,13 @@ SUPERVISOR_ONLY_FLAGS = {
     # serve p99 ms / tenant-imbalance excess treated as CRITICAL)
     "scaleP99Ms",
     "scaleImbalance",
+    # self-healing fleet (runtime/selfheal.SelfHealPolicy knobs)
+    "slotStrikes",
+    "probeAfterMs",
+    "probeWindowMs",
+    "restartGrowth",
+    "restartSeed",
+    "killDeadlineMs",
 }
 
 # exit code a worker fleet uses to signal "checkpointed and exiting for a
@@ -96,13 +112,33 @@ RESCALE_EXIT = 17
 
 
 class FleetFailure(RuntimeError):
-    """One failed attempt of the supervised fleet (cause + exit code)."""
+    """One failed attempt of the supervised fleet (cause + exit code +
+    per-slot failure classification, runtime/selfheal.classify_failure)."""
 
-    def __init__(self, cause: str, returncode: int, failed: Sequence[int]):
+    def __init__(
+        self,
+        cause: str,
+        returncode: int,
+        failed: Sequence[int],
+        kinds: Optional[Dict[int, str]] = None,
+    ):
         super().__init__(cause)
         self.cause = cause
         self.returncode = returncode
         self.failed = list(failed)
+        # slot -> failure class ("crash" | "hang" | "launch"); slots the
+        # detection path could not classify default to crash
+        self.kinds = dict(kinds or {})
+
+    def kind(self) -> str:
+        """The attempt's headline class: hang > launch > crash (a hang
+        implicates the fleet's liveness machinery, a launch failure will
+        repeat — both more actionable than a generic crash)."""
+        kinds = set(self.kinds.values())
+        for k in (HANG, "launch"):
+            if k in kinds:
+                return k
+        return CRASH
 
 
 @dataclasses.dataclass
@@ -114,6 +150,7 @@ class AttemptRecord:
     failed: List[int]  # process ids implicated
     at: float
     restored: bool  # whether a checkpoint existed to restore from
+    kind: str = CRASH  # headline failure class (crash | hang | launch)
 
 
 def _free_port() -> int:
@@ -126,12 +163,15 @@ def _free_port() -> int:
 
 @dataclasses.dataclass
 class RescaleRecord:
-    """One pressure-driven fleet rescale (the supervisor's scaling log)."""
+    """One fleet rescale (the supervisor's scaling log): autoscale
+    pressure decisions and self-heal re-expansion probes both land here
+    (probes ride the same signal file, cooldown and maxRescales budget)."""
 
     from_procs: int
     to_procs: int
     level: int  # folded fleet pressure level that drove the decision
     at: float
+    cause: str = "pressure"  # "pressure" (autoscale) | "probe" (self-heal)
 
 
 class _FleetRescaled(RuntimeError):
@@ -142,6 +182,17 @@ class _FleetRescaled(RuntimeError):
         super().__init__(f"fleet rescaling to {target} processes")
         self.target = target
         self.level = level
+
+
+@dataclasses.dataclass
+class DegradeRecord:
+    """One shrink-to-survivors transition (the supervisor's healing log)."""
+
+    from_procs: int
+    to_procs: int
+    slots: List[int]  # the struck-out slot ids (pre-shrink numbering)
+    kind: str  # headline failure class that struck them out
+    at: float
 
 
 class AutoscalePolicy:
@@ -330,6 +381,24 @@ class DistributedJobSupervisor:
     ``--rescaleCount``. A stale-but-present beat can pin the last
     reported level until the heartbeat timeout fires — arm
     ``heartbeat_timeout_s`` alongside autoscale in production.
+
+    Self-healing (``selfheal``, a :class:`~omldm_tpu.runtime.selfheal.
+    SelfHealPolicy`; ``--slotStrikes``): every FleetFailure is CLASSIFIED
+    (crash exit / heartbeat-silent hang / never-beat launch failure, with
+    survivors' reason-coded HANG_EXITs blaming the wedged peer) and
+    charged to its slots; ``strike_threshold`` consecutive failures of
+    one slot DEGRADE the fleet to the survivors (``N - |bad|``, floored
+    at the policy's ``min_processes``) through the same restore-with-
+    rescale relaunch a rescale uses — journaled as a DEGRADE event and
+    NOT charged against the restart budget. While degraded, the
+    supervisor periodically PROBES back toward the configured width via
+    the RESCALE signal file; a probe that stays healthy for the probe
+    window clears the strikes, a failed probe re-degrades immediately.
+    Restarts back off exponentially with deterministic jitter
+    (``restart_growth``/``restart_seed``; growth 1.0 recovers Flink's
+    fixed delay), and fleet kills escalate SIGTERM -> SIGKILL after
+    ``kill_deadline_s`` so a SIGSTOP'd worker cannot stall the restart
+    path.
     """
 
     def __init__(
@@ -349,6 +418,10 @@ class DistributedJobSupervisor:
         autoscale: Optional[AutoscalePolicy] = None,
         max_rescales: int = 32,
         blackbox_dir: Optional[str] = None,
+        selfheal: Optional[SelfHealPolicy] = None,
+        restart_growth: float = 2.0,
+        restart_seed: Optional[int] = None,
+        kill_deadline_s: float = 5.0,
     ):
         if num_processes < 1:
             raise ValueError(f"num_processes must be >= 1, got {num_processes}")
@@ -367,11 +440,25 @@ class DistributedJobSupervisor:
         self.poll_interval_s = poll_interval_s
         self._own_run_dir = run_dir is None
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="omldm-supervise-")
+        os.makedirs(self.run_dir, exist_ok=True)
         self.hb_dir = os.path.join(self.run_dir, "heartbeats")
         self.failures: List[AttemptRecord] = []
         self.autoscale = autoscale
         self.max_rescales = max_rescales
         self.rescales: List[RescaleRecord] = []
+        self.degrades: List[DegradeRecord] = []
+        # self-healing: classified-failure slot strikes + shrink-to-
+        # survivors + probed re-expansion (runtime/selfheal.py). None
+        # (the default) = the exact pre-policy restart behavior.
+        self.selfheal = selfheal
+        # restart backoff: exponential (growth) with seeded jitter
+        # through the shared RestartPolicy — growth 1.0 recovers the
+        # reference's fixedDelayRestart exactly. The policy is DERIVED
+        # from these attributes at run() time, so pre-run mutation of
+        # max_restarts/restart_delay_s keeps working.
+        self.restart_growth = restart_growth
+        self.restart_seed = restart_seed
+        self.kill_deadline_s = kill_deadline_s
         # flight recorder (runtime/events.py): with a black-box directory
         # — the same --blackboxPath the workers dump their rings into —
         # the supervisor keeps its OWN decision journal (restart/rescale/
@@ -397,6 +484,14 @@ class DistributedJobSupervisor:
             raise ValueError(
                 "autoscale requires --checkpointDir in the worker args "
                 "(rescale relaunches restore from the latest snapshot)"
+            )
+        if selfheal is not None and not self._checkpoint_root():
+            # shrink-to-survivors relaunches through restore-with-rescale;
+            # without a snapshot the degraded fleet would lose all state
+            raise ValueError(
+                "slotStrikes requires --checkpointDir in the worker args "
+                "(shrink-to-survivors restores the snapshot across the "
+                "surviving process count)"
             )
 
     def _log(self, msg: str) -> None:
@@ -431,6 +526,7 @@ class DistributedJobSupervisor:
                 "processes": self.nproc,
                 "restarts": len(self.failures),
                 "rescales": len(self.rescales),
+                "degrades": len(self.degrades),
             },
         )
         if path is not None:
@@ -448,17 +544,37 @@ class DistributedJobSupervisor:
             args += ["--restore", "true"]
         if self._beats_armed():
             args += ["--heartbeatDir", self.hb_dir]
-        if self.autoscale is not None:
+        if self._signal_armed():
             args += [
                 "--rescaleSignalDir", self.run_dir,
                 "--rescaleCount", str(len(self.rescales)),
             ]
+        if self.selfheal is not None:
+            # the degraded-width gauge rides to Statistics/the job report
+            # the same way --rescaleCount does (authoritative, pinned):
+            # slots this LAUNCH is short of the configured width — a probe
+            # fleet launches at full width, so its gauge reads 0
+            args += [
+                "--fleetDegraded",
+                str(max(self.selfheal.configured - self.nproc, 0)),
+            ]
         return args
 
     def _beats_armed(self) -> bool:
-        # the heartbeat files double as the pressure channel, so the
-        # autoscaler arms them even without a liveness timeout
-        return self.heartbeat_timeout_s > 0 or self.autoscale is not None
+        # the heartbeat files double as the pressure channel AND the
+        # failure-classification channel (launch = never beat, hang =
+        # silent), so the autoscaler and the self-heal policy both arm
+        # them even without a liveness timeout
+        return (
+            self.heartbeat_timeout_s > 0
+            or self.autoscale is not None
+            or self.selfheal is not None
+        )
+
+    def _signal_armed(self) -> bool:
+        # the RESCALE signal file serves two writers: autoscale decisions
+        # and self-heal re-expansion probes
+        return self.autoscale is not None or self.selfheal is not None
 
     def _checkpoint_root(self) -> Optional[str]:
         root = None
@@ -561,22 +677,59 @@ class DistributedJobSupervisor:
         }
 
     def _kill_fleet(self, procs: List[subprocess.Popen]) -> None:
-        for p in procs:
-            if p.poll() is None:
-                try:
-                    p.terminate()
-                except OSError:
-                    pass
-        deadline = time.monotonic() + 5.0
-        for p in procs:
-            while p.poll() is None and time.monotonic() < deadline:
-                time.sleep(0.02)
-            if p.poll() is None:
-                try:
-                    p.kill()
-                except OSError:
-                    pass
-                p.wait()
+        # SIGTERM -> deadline -> SIGKILL (runtime/selfheal.kill_escalate):
+        # a SIGSTOP'd or natively-wedged worker never honors SIGTERM, and
+        # the supervisor's own restart path must not stall behind it
+        escalated = kill_escalate(procs, self.kill_deadline_s)
+        if escalated:
+            self._log(
+                "process "
+                + ", ".join(map(str, escalated))
+                + " ignored SIGTERM (stopped/wedged); escalated to SIGKILL"
+            )
+
+    def _ever_beat(self, pid: int) -> Optional[bool]:
+        """Whether this worker heartbeat at least once THIS attempt (the
+        launch-vs-crash classification signal; the heartbeat dir is wiped
+        at every attempt start). None when beats are unarmed — the
+        classes are then indistinguishable."""
+        if not self._beats_armed():
+            return None
+        return os.path.exists(os.path.join(self.hb_dir, f"proc{pid}.hb"))
+
+    def _classify_exits(
+        self, codes: List[Optional[int]], bad: List[int]
+    ) -> FleetFailure:
+        """Build the classified FleetFailure for bad exit codes. HANG_EXIT
+        is a VICTIM's code ("my peer is wedged; I refuse to block
+        forever"): when every bad exit is a HANG_EXIT and some process is
+        still alive, the blame lands on the live (wedged, probably
+        SIGSTOP'd/stuck-in-native) processes, not the honest survivors."""
+        live = [i for i, rc in enumerate(codes) if rc is None]
+        hang_exits = [i for i in bad if codes[i] == HANG_EXIT]
+        if hang_exits and len(hang_exits) == len(bad) and live:
+            return FleetFailure(
+                "process "
+                + ", ".join(f"{i} exited HANG_EXIT" for i in hang_exits)
+                + "; blaming wedged process "
+                + ", ".join(map(str, live)),
+                returncode=HANG_EXIT,
+                failed=live,
+                kinds={i: HANG for i in live},
+            )
+        kinds = {
+            i: classify_failure(
+                returncode=codes[i], ever_beat=self._ever_beat(i)
+            )
+            for i in bad
+        }
+        return FleetFailure(
+            "process "
+            + ", ".join(f"{i} exited {codes[i]}" for i in bad),
+            returncode=codes[bad[0]],
+            failed=bad,
+            kinds=kinds,
+        )
 
     def _run_attempt(self, restore: bool) -> None:
         """Spawn the fleet and block until success (all exit 0), a
@@ -589,7 +742,7 @@ class DistributedJobSupervisor:
             os.makedirs(self.hb_dir, exist_ok=True)
         if self.autoscale is not None:
             self.autoscale.reset()
-        ok_codes = (0,) if self.autoscale is None else (0, RESCALE_EXIT)
+        ok_codes = (0,) if not self._signal_armed() else (0, RESCALE_EXIT)
         pending_target = 0  # a written-but-not-yet-honored rescale signal
         decision_level = 0
         port = _free_port()
@@ -602,6 +755,10 @@ class DistributedJobSupervisor:
             )
             for pid in range(self.nproc)
         ]
+        if self.selfheal is not None:
+            # a probe fleet's health window starts at ITS spawn, not at
+            # signal time (checkpoint+relaunch latency is not health)
+            self.selfheal.note_spawn(time.monotonic())
         try:
             while True:
                 codes = [p.poll() for p in procs]
@@ -611,16 +768,11 @@ class DistributedJobSupervisor:
                     if rc is not None and rc not in ok_codes
                 ]
                 if bad:
-                    raise FleetFailure(
-                        "process "
-                        + ", ".join(f"{i} exited {codes[i]}" for i in bad),
-                        returncode=codes[bad[0]],
-                        failed=bad,
-                    )
+                    raise self._classify_exits(codes, bad)
                 if all(rc == 0 for rc in codes):
                     return
                 if (
-                    self.autoscale is not None
+                    self._signal_armed()
                     and all(rc is not None for rc in codes)
                     and any(rc == RESCALE_EXIT for rc in codes)
                 ):
@@ -645,6 +797,50 @@ class DistributedJobSupervisor:
                             + ", ".join(map(str, stale)),
                             returncode=1,
                             failed=stale,
+                            kinds={i: HANG for i in stale},
+                        )
+                if self.selfheal is not None and not pending_target:
+                    # probed re-expansion: a degraded fleet that has run
+                    # quietly for probeAfterMs gets signaled back toward
+                    # the configured width (same RESCALE signal file +
+                    # checkpoint/relaunch machinery as autoscale)
+                    mono = time.monotonic()
+                    if self.selfheal.tick_healthy(mono):
+                        self._log(
+                            "probe healthy for "
+                            f"{self.selfheal.probe_window_s:.1f}s: fleet "
+                            f"healed at {self.nproc} processes; slot "
+                            "strikes cleared"
+                        )
+                        from omldm_tpu.runtime.events import PROBE
+
+                        self._record(
+                            PROBE, "probe_healthy", processes=self.nproc,
+                        )
+                        self._write_strike_file()
+                    target = self.selfheal.probe_target(self.nproc, mono)
+                    if len(self.rescales) >= self.max_rescales:
+                        # probes ride the rescale budget; once it is
+                        # spent the fleet stays at the degraded width
+                        # (signaling anyway would fail the relaunch
+                        # inside _apply_rescale and livelock the
+                        # degrade/probe loop without consuming attempts)
+                        target = None
+                    if target is not None and target != self.nproc:
+                        pending_target = target
+                        self.selfheal.note_probe_signaled()
+                        from omldm_tpu.runtime.events import PROBE
+
+                        self._record(
+                            PROBE, "probe_signaled",
+                            from_procs=self.nproc, target=target,
+                        )
+                        with open(self._signal_path(), "w") as f:
+                            f.write(str(target))
+                        self._log(
+                            f"degraded fleet quiet for "
+                            f"{self.selfheal.probe_after_s:.1f}s: probing "
+                            f"back {self.nproc} -> {target} processes"
                         )
                 if self.autoscale is not None and not pending_target:
                     # ONE frame read per worker per poll: the level is
@@ -686,6 +882,100 @@ class DistributedJobSupervisor:
         root = self._checkpoint_root()
         return bool(root) and os.path.exists(os.path.join(root, "LATEST"))
 
+    # --- self-healing: strikes, shrink-to-survivors, probes ---------------
+
+    def _write_strike_file(self) -> None:
+        """Persist the strike/degrade state into the run dir (operator
+        observability; the POLICY state itself lives in this process and
+        survives fleet restarts by construction). Best-effort."""
+        if self.selfheal is None:
+            return
+        import json as _json
+
+        try:
+            with open(os.path.join(self.run_dir, "STRIKES"), "w") as f:
+                f.write(_json.dumps(self.selfheal.snapshot()))
+        except OSError:
+            pass
+
+    def _note_strikes(self, exc: FleetFailure) -> Optional[int]:
+        """Charge a classified fleet failure to its blamed slots; returns
+        the shrink-to-survivors target (None = route the failure through
+        the normal restart policy). Every classification is journaled as
+        a STRIKE event — the first link of the incident chain."""
+        if self.selfheal is None:
+            return None
+        from omldm_tpu.runtime.events import STRIKE
+
+        was_probing = self.selfheal.probing
+        if was_probing:
+            # a failure with a probe in flight (signaled, spawned or not)
+            # voids the probe: the standing signal must not be honored by
+            # the NEXT incarnation as a mislabeled, health-ungated
+            # re-expansion (the autoscale path deliberately keeps stale
+            # signals; probes must not)
+            try:
+                os.unlink(self._signal_path())
+            except OSError:
+                pass
+        target = self.selfheal.note_failure(
+            exc.failed, exc.kinds, self.nproc, time.monotonic()
+        )
+        for slot in exc.failed:
+            self._record(
+                STRIKE, exc.kinds.get(slot, CRASH), worker=slot,
+                strikes=self.selfheal.strikes.get(slot, 0) or
+                self.selfheal.strike_threshold,
+                error=exc.cause,
+            )
+        if was_probing and target is not None:
+            from omldm_tpu.runtime.events import PROBE
+
+            self._record(
+                PROBE, "probe_failed", target=target, error=exc.cause,
+            )
+            self._log(
+                f"re-expansion probe failed ({exc.cause}); re-degrading "
+                f"to {target} processes immediately"
+            )
+        self._write_strike_file()
+        return target
+
+    def _apply_degrade(self, exc: FleetFailure, target: int) -> None:
+        """Commit a shrink-to-survivors: journal the DEGRADE decision,
+        bundle the dead fleet's rings, and relaunch at the survivor count
+        through restore-with-rescale — WITHOUT consuming a restart
+        attempt (a planned capacity decision, not another crash)."""
+        record = DegradeRecord(
+            from_procs=self.nproc,
+            to_procs=target,
+            slots=list(exc.failed),
+            kind=exc.kind(),
+            at=time.time(),
+        )
+        self.degrades.append(record)
+        self._log(
+            f"slot {', '.join(map(str, exc.failed))} struck out "
+            f"({exc.kind()}: {exc.cause}); degrading fleet "
+            f"{self.nproc} -> {target} processes (shrink-to-survivors; "
+            f"restore-with-rescale relaunch)"
+        )
+        from omldm_tpu.runtime.events import DEGRADE
+
+        self._record(
+            DEGRADE, exc.kind(), from_procs=self.nproc, to_procs=target,
+            slots=list(exc.failed), error=exc.cause,
+        )
+        # the dead fleet's rings are about to be overwritten by the
+        # degraded incarnation's dumps: bundle them now (no-op unarmed)
+        self.gather_incident("degrade")
+        self.nproc = target
+        if self.autoscale is not None:
+            # a degrade IS a rescale as far as autoscale pacing goes: give
+            # the shrunken fleet the same cooldown before the next decision
+            self.autoscale.note_rescaled(time.monotonic())
+        self._write_strike_file()
+
     def _apply_rescale(self, rescaled: "_FleetRescaled") -> None:
         """Commit a pressure-driven rescale: clear the signal, record the
         decision, move the fleet width, start the cooldown clock."""
@@ -700,22 +990,28 @@ class DistributedJobSupervisor:
             os.unlink(self._signal_path())
         except OSError:
             pass
+        probe = self.selfheal is not None and self.selfheal.probing
+        cause = "probe" if probe else "pressure"
         self.rescales.append(
             RescaleRecord(
                 from_procs=self.nproc,
                 to_procs=rescaled.target,
                 level=rescaled.level,
                 at=time.time(),
+                cause=cause,
             )
         )
         self._log(
             f"rescaling fleet {self.nproc} -> {rescaled.target} processes "
-            f"(pressure-driven; rescale {len(self.rescales)})"
+            f"({'re-expansion probe' if probe else 'pressure-driven'}; "
+            f"rescale {len(self.rescales)})"
         )
         from omldm_tpu.runtime.events import RESCALE
 
         self._record(
-            RESCALE, "pressure_driven", from_procs=self.nproc,
+            RESCALE,
+            "probe_agreed" if probe else "pressure_driven",
+            from_procs=self.nproc,
             to_procs=rescaled.target, level=rescaled.level,
         )
         # the pre-relaunch worker rings are about to be overwritten by
@@ -749,9 +1045,23 @@ class DistributedJobSupervisor:
                     )
                 try:
                     self._run_attempt(restore=restore)
+                    if self.selfheal is not None:
+                        # a clean completion ends every consecutive-
+                        # failure streak
+                        self.selfheal.note_healthy_attempt()
+                        self._write_strike_file()
                     return 0
                 except _FleetRescaled as rescaled:
                     self._apply_rescale(rescaled)
+                    restore = True
+                except FleetFailure as exc:
+                    # classified slot strikes: a struck-out slot shrinks
+                    # the fleet to the survivors INSTEAD of burning a
+                    # restart attempt on a width that keeps failing
+                    target = self._note_strikes(exc)
+                    if target is None:
+                        raise
+                    self._apply_degrade(exc, target)
                     restore = True
 
         def on_retry(exc: Exception, next_attempt: int) -> None:
@@ -761,10 +1071,13 @@ class DistributedJobSupervisor:
                 failed=getattr(exc, "failed", []),
                 at=time.time(),
                 restored=self._checkpoint_exists(),
+                kind=(
+                    exc.kind() if isinstance(exc, FleetFailure) else CRASH
+                ),
             )
             self.failures.append(record)
             self._log(
-                f"fleet failure ({record.cause}); restart "
+                f"fleet failure ({record.kind}: {record.cause}); restart "
                 f"{record.attempt}/{self.max_restarts}"
             )
             from omldm_tpu.runtime.events import RESTART
@@ -772,22 +1085,29 @@ class DistributedJobSupervisor:
             self._record(
                 RESTART, "fleet_failure", error=record.cause,
                 failed=list(record.failed), attempt=record.attempt,
-                restored=record.restored,
+                restored=record.restored, failure_kind=record.kind,
             )
             # bundle the dead fleet's rings BEFORE the relaunch
             # overwrites them — this is the supervised-worker-death
             # incident (no-op unarmed)
             self.gather_incident("worker_death")
 
+        restart_policy = RestartPolicy(
+            max_restarts=self.max_restarts,
+            base_delay_s=self.restart_delay_s,
+            growth=self.restart_growth,
+            jitter_s=self.restart_jitter_s,
+            seed=self.restart_seed,
+        )
         try:
+            # exponential backoff with seeded jitter through the shared
+            # RestartPolicy (growth 1.0 == Flink's fixed delay)
             return with_backoff(
                 attempt,
-                attempts=self.max_restarts + 1,
-                base_delay=self.restart_delay_s,
-                growth=1.0,  # Flink's fixed-delay restart strategy
-                jitter=self.restart_jitter_s,
+                policy=restart_policy.backoff(),
                 retry_on=(FleetFailure,),
                 on_retry=on_retry,
+                rng=restart_policy.rng(),
             )
         except FleetFailure as exc:
             # the terminal failure is an incident too (parity with the
@@ -799,6 +1119,7 @@ class DistributedJobSupervisor:
                     failed=exc.failed,
                     at=time.time(),
                     restored=self._checkpoint_exists(),
+                    kind=exc.kind(),
                 )
             )
             self._log(
@@ -868,6 +1189,23 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
             serve_p99_critical_ms=float(flags.get("scaleP99Ms", "0")),
             imbalance_critical=float(flags.get("scaleImbalance", "0")),
         )
+    selfheal = None
+    strikes = int(flags.get("slotStrikes", "0") or 0)
+    if strikes > 0:
+        if not flags.get("checkpointDir"):
+            raise SystemExit(
+                "--slotStrikes requires --checkpointDir "
+                "(shrink-to-survivors restores the snapshot across the "
+                "surviving process count)"
+            )
+        selfheal = SelfHealPolicy(
+            strikes,
+            nproc,
+            min_processes=int(flags.get("minProcesses", "1")),
+            probe_after_s=float(flags.get("probeAfterMs", "30000")) / 1000.0,
+            probe_window_s=float(flags.get("probeWindowMs", "10000"))
+            / 1000.0,
+        )
     sup = DistributedJobSupervisor(
         worker_args,
         nproc,
@@ -884,6 +1222,17 @@ def supervise_from_flags(flags: Dict[str, str]) -> int:
         # via the passthrough --blackboxPath flag); the supervisor
         # gathers them + its own decision log into incident bundles
         blackbox_dir=flags.get("blackboxPath"),
+        # self-healing fleet: classified slot strikes -> shrink-to-
+        # survivors -> probed re-expansion (runtime/selfheal.py)
+        selfheal=selfheal,
+        # restart hardening: exponential backoff (growth 1.0 recovers the
+        # reference's fixed delay exactly); --restartSeed pins the jitter
+        # stream (unset = pid-derived, so co-hosted fleets desynchronize)
+        restart_growth=float(flags.get("restartGrowth", "2.0")),
+        restart_seed=(
+            int(flags["restartSeed"]) if "restartSeed" in flags else None
+        ),
+        kill_deadline_s=float(flags.get("killDeadlineMs", "5000")) / 1000.0,
     )
     try:
         return sup.run()
@@ -913,6 +1262,18 @@ class DistributedFaultInjector:
       Kafka broker (renames the ``FSKAFKA_DIR`` directory) mid-stream —
       consumers go permanently idle, producer (re)connects fail; the job
       must degrade to warnings + file sinks, not crash.
+    - ``--hangProcess p --hangAfterChunks k``: process ``p`` SIGSTOPs
+      ITSELF at chunk ``k`` — alive but frozen: never beating, never
+      exiting, wedging every peer's next collective. Drives the hang
+      classification, the survivors' collective watchdog (HANG_EXIT) and
+      the supervisor's SIGKILL escalation. One-shot ACROSS incarnations
+      when ``--faultStateDir`` names a directory for the marker file
+      (without it, every incarnation of process ``p`` hangs again).
+    - ``--refuseLaunchProcess p --refuseLaunchCount n``: process ``p``
+      hard-exits at injector construction — before its first heartbeat —
+      for the first ``n`` incarnations (counted in
+      ``--faultStateDir``): the un-launchable-slot fault the LAUNCH
+      classification and slot strikes exist for.
 
     All triggers are one-shot and deterministic given a fixed chunk size.
     """
@@ -928,12 +1289,71 @@ class DistributedFaultInjector:
         self.corrupt_seq = int(flags.get("corruptShardSeq", "-1"))
         self.corrupt_mode = flags.get("corruptShardMode", "truncate")
         self.sever_after_chunks = int(flags.get("severBrokerAfterChunks", "0"))
+        # self-heal fault classes (runtime/selfheal.py consumers)
+        self.hang_process = int(flags.get("hangProcess", "-1"))
+        self.hang_after_chunks = int(flags.get("hangAfterChunks", "0"))
+        self.refuse_launch_process = int(
+            flags.get("refuseLaunchProcess", "-1")
+        )
+        self.refuse_launch_count = int(flags.get("refuseLaunchCount", "0"))
+        # cross-incarnation fault state (markers/counters): supervised
+        # relaunches re-run the injector with the SAME flags, so one-shot
+        # faults need disk state to stay one-shot
+        self.fault_state_dir = flags.get("faultStateDir", "")
         self.records_seen = 0
         self._severed = False
+        self._hung = False
 
     def note_records(self, n: int) -> None:
         """Count records this process's ingest moved past a pump point."""
         self.records_seen += int(n)
+
+    def _once(self, name: str) -> bool:
+        """True exactly once across incarnations (marker file in the
+        fault state dir); without a state dir, True every incarnation —
+        fine for single-incarnation unit tests, documented above."""
+        if not self.fault_state_dir:
+            return True
+        marker = os.path.join(self.fault_state_dir, name)
+        try:
+            os.makedirs(self.fault_state_dir, exist_ok=True)
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except OSError:
+            return False  # marker exists (or undrivable dir): already fired
+
+    def on_launch(self) -> None:
+        """Called once at worker startup, BEFORE the first heartbeat: the
+        launch-refusal fault exits here so the supervisor's classifier
+        sees a process that died without ever coming up."""
+        if (
+            self.refuse_launch_process != self.pid
+            or self.refuse_launch_count <= 0
+        ):
+            return
+        counter = os.path.join(
+            self.fault_state_dir or ".", f"refused.p{self.pid}"
+        )
+        n = 0
+        try:
+            with open(counter) as f:
+                n = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            n = 0
+        if n >= self.refuse_launch_count:
+            return
+        try:
+            if self.fault_state_dir:
+                os.makedirs(self.fault_state_dir, exist_ok=True)
+            with open(counter, "w") as f:
+                f.write(str(n + 1))
+        except OSError:
+            pass
+        self._die(
+            f"worker {self.pid} refused launch "
+            f"({n + 1}/{self.refuse_launch_count})"
+        )
 
     def _die(self, why: str) -> None:
         print(
@@ -966,6 +1386,24 @@ class DistributedFaultInjector:
         ):
             self._severed = True
             self._sever_broker()
+        if (
+            self.hang_process == self.pid
+            and self.hang_after_chunks
+            and chunk_idx + 1 >= self.hang_after_chunks
+            and not self._hung
+            and self._once(f"hang.p{self.pid}")
+        ):
+            self._hung = True
+            print(
+                f"[fault-injector p{self.pid}] injected hang: SIGSTOP "
+                f"after chunk {chunk_idx + 1} (process stays alive, "
+                "frozen — no beats, no exit)",
+                file=sys.stderr,
+                flush=True,
+            )
+            from omldm_tpu.runtime.selfheal import sigstop_self
+
+            sigstop_self()
 
     def on_checkpoint(self, ckpt_dir: str) -> None:
         """Called after a distributed snapshot commits (post-barrier)."""
@@ -1530,6 +1968,8 @@ def maybe_chaos_consumer(
 __all__ = [
     "AttemptRecord",
     "AutoscalePolicy",
+    "DegradeRecord",
+    "HANG_EXIT",
     "RESCALE_EXIT",
     "RescaleRecord",
     "BurstInjector",

@@ -28,8 +28,21 @@ from __future__ import annotations
 
 import random
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Optional, Tuple, Type
+
+
+def seeded_rng(seed: int, name: str = "backoff") -> Callable[[], float]:
+    """A DETERMINISTIC uniform-[0,1) stream for backoff jitter: same
+    ``(seed, name)`` => same delay schedule, every run, every machine
+    (crc32, not the per-process-salted ``hash()`` — the chaos-channel
+    seeding rule). Jitter desynchronizes a fleet of retriers; making it
+    deterministic keeps supervised-restart timing replayable in tests and
+    incident reconstructions."""
+    return random.Random(
+        (int(seed) ^ zlib.crc32(name.encode())) & 0x7FFFFFFF
+    ).random
 
 
 @dataclass(frozen=True)

@@ -211,6 +211,14 @@ def _fabricate_two_proc_snapshot(d, scale_row1=1.5, preds1=(9.0,)):
         manifest = json.load(f)
     manifest["processes"] = 2
     manifest["dp_global"] = 2
+    # refresh the integrity digest of the rewritten fleet file (proc1's
+    # npz is a byte copy of proc0's, so its meta digest still matches)
+    from omldm_tpu.runtime.distributed_job import _file_sha256
+
+    if manifest.get("digests"):
+        manifest["digests"]["fleet_0.npz"] = _file_sha256(
+            os.path.join(d, "fleet_0.npz")
+        )
     with open(os.path.join(d, "manifest.json"), "w") as f:
         json.dump(manifest, f)
 

@@ -1,0 +1,806 @@
+"""Self-healing fleet (ISSUE 15): failure classification, slot strikes,
+shrink-to-survivors, probed re-expansion, hang watchdogs, kill escalation.
+
+Pins:
+
+- the failure taxonomy: crash exits, heartbeat-silent hangs, survivors'
+  HANG_EXITs blaming the wedged peer, never-beat launch failures (and the
+  in-process classify_exception twin);
+- SelfHealPolicy's strike/degrade/probe state machine with an injectable
+  clock: per-slot consecutive strikes, threshold-triggered shrink targets
+  (floored at minProcesses), strike reset on width change, probe cadence,
+  probe-window healing, immediate re-degrade on a failed probe;
+- HangWatchdog semantics: re-entrant deadline guards refreshed on entry,
+  per-phase cold-compile warmup allowance, fire-once expiry (deterministic
+  non-threaded form + a real-thread firing test);
+- kill escalation: SIGTERM -> deadline -> SIGKILL so a stopped/wedged
+  process cannot stall the supervisor's restart path;
+- supervisor wiring: classified FleetFailures, strike accounting that
+  survives fleet restarts, degrade relaunches that burn no restart
+  attempt, the --fleetDegraded gauge, deterministic restart jitter;
+- checkpoint integrity: sha256 digests in the distributed manifest /
+  shard metas, digest-mismatch rejection with generation fallback, and
+  the single-process CheckpointManager's generation fallback;
+- ENOSPC survival: black-box ring dumps, dead-letter appends and
+  heartbeat files degrade to dropped-write counters, never a raise;
+- (slow) the full loop: a SIGSTOP'd worker is detected, survivors exit
+  HANG_EXIT within the collective timeout, the fleet shrinks to the
+  survivors with exact row conservation and exactly-once forecasts, then
+  probes back to full width and heals.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from omldm_tpu.runtime.selfheal import (
+    CRASH,
+    HANG,
+    HANG_EXIT,
+    LAUNCH,
+    HangWatchdog,
+    RestartPolicy,
+    SelfHealPolicy,
+    classify_exception,
+    classify_failure,
+    kill_escalate,
+)
+from omldm_tpu.runtime.supervisor import (
+    DistributedFaultInjector,
+    DistributedJobSupervisor,
+    FleetFailure,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+DIM = 6
+
+FSKAFKA_BOOT = (
+    "import sys; sys.path.insert(0, {tests!r}); "
+    "import fskafka; fskafka.install(); "
+    "from omldm_tpu.runtime.distributed_job import run_distributed; "
+    "sys.exit(run_distributed(sys.argv[1:]))"
+).format(tests=TESTS)
+
+
+# --- classification ----------------------------------------------------------
+
+
+class TestClassification:
+    def test_crash(self):
+        assert classify_failure(returncode=3, ever_beat=True) == CRASH
+        assert classify_failure(returncode=1) == CRASH
+
+    def test_hang_from_silence(self):
+        assert classify_failure(heartbeat_silent=True) == HANG
+        # silence outranks the never-beat heuristic (a wedged worker that
+        # froze before its first beat is still a hang, not a launch)
+        assert (
+            classify_failure(heartbeat_silent=True, ever_beat=False) == HANG
+        )
+
+    def test_hang_exit_is_hang(self):
+        assert classify_failure(returncode=HANG_EXIT, ever_beat=True) == HANG
+
+    def test_launch_never_beat(self):
+        assert classify_failure(returncode=3, ever_beat=False) == LAUNCH
+
+    def test_unarmed_beats_degrade_to_crash(self):
+        # without the heartbeat channel, launch is indistinguishable
+        assert classify_failure(returncode=3, ever_beat=None) == CRASH
+
+    def test_exception_twin(self):
+        assert classify_exception(RuntimeError("x"), progressed=True) == CRASH
+        assert classify_exception(RuntimeError("x"), progressed=False) == LAUNCH
+        assert classify_exception(TimeoutError(), progressed=True) == HANG
+
+
+# --- restart policy ----------------------------------------------------------
+
+
+class TestRestartPolicy:
+    def test_backoff_fields(self):
+        rp = RestartPolicy(
+            max_restarts=3, base_delay_s=0.5, growth=2.0, jitter_s=0.1
+        )
+        policy = rp.backoff()
+        assert policy.attempts == 4
+        assert policy.base_delay == 0.5
+        assert policy.growth == 2.0
+        assert policy.jitter == 0.1
+
+    def test_exponential_delays(self):
+        policy = RestartPolicy(base_delay_s=0.1, growth=2.0).backoff()
+        rng = RestartPolicy(seed=0).rng()
+        assert policy.delay(1, rng) == pytest.approx(0.1)
+        assert policy.delay(2, rng) == pytest.approx(0.2)
+        assert policy.delay(3, rng) == pytest.approx(0.4)
+
+    def test_deterministic_jitter(self):
+        a = [RestartPolicy(seed=7).rng()() for _ in range(8)]
+        b = [RestartPolicy(seed=7).rng()() for _ in range(8)]
+        c = [RestartPolicy(seed=8).rng()() for _ in range(8)]
+        assert a == b          # same seed: same delay schedule
+        assert a != c          # different seed: desynchronized
+        assert all(0.0 <= u < 1.0 for u in a)
+
+    def test_default_seed_is_pid_derived(self):
+        # unset seed: the stream keys off the supervisor's pid (co-hosted
+        # fleets desynchronize without an operator remembering a knob);
+        # within one process that's still a stable, usable stream
+        d = [RestartPolicy().rng()() for _ in range(4)]
+        e = [RestartPolicy(seed=os.getpid()).rng()() for _ in range(4)]
+        assert d == e
+
+
+# --- strike/degrade/probe state machine --------------------------------------
+
+
+def _policy(**kw):
+    kw.setdefault("min_processes", 1)
+    kw.setdefault("probe_after_s", 5.0)
+    kw.setdefault("probe_window_s", 3.0)
+    kw.setdefault("clock", lambda: 0.0)
+    return SelfHealPolicy(kw.pop("threshold", 2), kw.pop("configured", 4), **kw)
+
+
+class TestSelfHealPolicy:
+    def test_strikes_accrue_per_slot(self):
+        p = _policy()
+        assert p.note_failure([1], {1: CRASH}, 4, 0.0) is None
+        assert p.strikes == {1: 1}
+        assert p.note_failure([2], {2: CRASH}, 4, 1.0) is None
+        assert p.strikes == {1: 1, 2: 1}  # different slot: no threshold
+
+    def test_threshold_degrades_to_survivors(self):
+        p = _policy()
+        p.note_failure([1], {1: CRASH}, 4, 0.0)
+        assert p.note_failure([1], {1: HANG}, 4, 1.0) == 3
+        assert p.degraded and p.degraded_by == 1
+        assert p.strikes == {}  # widths renumber: counts reset
+
+    def test_healthy_attempt_resets_streak(self):
+        p = _policy()
+        p.note_failure([1], {1: CRASH}, 4, 0.0)
+        p.note_healthy_attempt()
+        assert p.note_failure([1], {1: CRASH}, 4, 1.0) is None  # not consec.
+
+    def test_multi_slot_failure_degrades_by_all(self):
+        p = _policy(threshold=1)
+        assert p.note_failure([1, 3], {1: HANG, 3: HANG}, 4, 0.0) == 2
+        assert p.degraded_by == 2
+
+    def test_floor(self):
+        p = _policy(threshold=1, configured=2, min_processes=2)
+        # already at the floor: nothing to shrink away
+        assert p.note_failure([0], {0: CRASH}, 2, 0.0) is None
+        assert not p.degraded
+
+    def test_probe_cadence(self):
+        p = _policy(threshold=1)
+        p.note_failure([1], {1: CRASH}, 4, 10.0)
+        assert p.probe_target(3, 14.9) is None  # quiet < probe_after_s
+        assert p.probe_target(3, 15.1) == 4
+        p.note_probe_signaled()
+        assert p.probing
+        assert p.probe_target(4, 99.0) is None  # one probe at a time
+
+    def test_probe_heals_after_window(self):
+        p = _policy(threshold=1)
+        p.note_failure([1], {1: CRASH}, 4, 0.0)
+        p.note_probe_signaled()
+        p.note_spawn(20.0)
+        assert not p.tick_healthy(22.9)
+        assert p.tick_healthy(23.1)
+        assert not p.tick_healthy(24.0)  # fires exactly once
+        assert not p.degraded and p.strikes == {} and p.heals == 1
+
+    def test_failed_probe_redegrades_immediately(self):
+        p = _policy(threshold=2)
+        p.note_failure([1], {1: CRASH}, 4, 0.0)
+        p.note_failure([1], {1: CRASH}, 4, 1.0)  # degrade to 3
+        p.note_probe_signaled()
+        p.note_spawn(10.0)
+        # failure inside the window: back to 3, no strike budget consumed
+        assert p.note_failure([1], {1: CRASH}, 4, 11.0) == 3
+        assert p.probe_failures == 1 and not p.probing
+        assert p.degraded_by == 1
+
+    def test_spawn_starts_window_not_signal(self):
+        p = _policy(threshold=1)
+        p.note_failure([1], {1: CRASH}, 4, 0.0)
+        p.note_probe_signaled()
+        # checkpoint+relaunch latency between signal and spawn must not
+        # eat the health window
+        p.note_spawn(50.0)
+        assert not p.tick_healthy(52.0)
+        assert p.tick_healthy(53.5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SelfHealPolicy(0, 4)
+        with pytest.raises(ValueError):
+            SelfHealPolicy(1, 4, min_processes=0)
+        with pytest.raises(ValueError):
+            SelfHealPolicy(1, 1, min_processes=2)
+
+    def test_snapshot_shape(self):
+        p = _policy(threshold=1)
+        p.note_failure([1], {1: HANG}, 4, 0.0)
+        snap = p.snapshot()
+        assert snap["degradedBy"] == 1 and snap["degrades"] == 1
+        json.dumps(snap)  # strike-file serializable
+
+
+# --- kill escalation ---------------------------------------------------------
+
+
+class _FakeProc:
+    """Popen-shaped: ``polite`` dies on terminate(), a stubborn (SIGSTOP'd
+    / native-wedged) one only on kill()."""
+
+    def __init__(self, polite: bool):
+        self.polite = polite
+        self.rc = None
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        if self.polite:
+            self.rc = -15
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def wait(self):
+        return self.rc
+
+
+class TestKillEscalate:
+    def test_polite_fleet_never_escalates(self):
+        procs = [_FakeProc(True), _FakeProc(True)]
+        clock = [0.0]
+        escalated = kill_escalate(
+            procs, 1.0, clock=lambda: clock[0],
+            sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+        )
+        assert escalated == []
+        assert all(p.terminated and not p.killed for p in procs)
+
+    def test_stubborn_proc_gets_sigkill(self):
+        procs = [_FakeProc(True), _FakeProc(False)]
+        clock = [0.0]
+        escalated = kill_escalate(
+            procs, 1.0, clock=lambda: clock[0],
+            sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+        )
+        assert escalated == [1]
+        assert procs[1].killed and procs[1].rc == -9
+        assert not procs[0].killed
+
+    def test_already_dead_fleet_untouched(self):
+        p = _FakeProc(True)
+        p.rc = 0
+        assert kill_escalate([p], 1.0) == []
+        assert not p.terminated and not p.killed
+
+
+# --- hang watchdog -----------------------------------------------------------
+
+
+class TestHangWatchdog:
+    def _wd(self, timeout=10.0, warmup=None, clock=None):
+        fired = []
+        wd = HangWatchdog(
+            timeout, fired.append, warmup_s=warmup,
+            clock=clock or (lambda: self.now), thread=False,
+        )
+        return wd, fired
+
+    def test_unarmed_never_fires(self):
+        self.now = 0.0
+        wd, fired = self._wd()
+        self.now = 1e9
+        assert not wd.check()
+        assert fired == []
+
+    def test_guard_deadline_fires_once(self):
+        self.now = 0.0
+        wd, fired = self._wd(timeout=10.0, warmup=10.0)
+        with wd.guard("pump"):
+            self.now = 9.0
+            assert not wd.check()
+            self.now = 11.0
+            assert wd.check()
+            assert not wd.check()  # fire-once
+        assert fired == ["pump"]
+
+    def test_exit_disarms(self):
+        self.now = 0.0
+        wd, fired = self._wd(timeout=5.0, warmup=5.0)
+        with wd.guard("pump"):
+            pass
+        self.now = 100.0
+        assert not wd.check()
+        assert fired == []
+
+    def test_reentrant_refresh(self):
+        self.now = 0.0
+        wd, fired = self._wd(timeout=5.0, warmup=5.0)
+        with wd.guard("pump"):
+            for _ in range(10):
+                self.now += 4.0
+                with wd.guard("reduce"):  # progress refreshes the deadline
+                    pass
+                assert not wd.check()
+            # inner exits must NOT disarm the outer guard
+            self.now += 6.0
+            assert wd.check()
+        assert len(fired) == 1
+
+    def test_warmup_allowance_first_entry_per_phase(self):
+        self.now = 0.0
+        wd, fired = self._wd(timeout=5.0, warmup=60.0)
+        with wd.guard("pump"):  # first entry: cold-compile allowance
+            self.now = 50.0
+            assert not wd.check()
+        with wd.guard("pump"):  # warmed: normal timeout
+            self.now = 56.0
+            assert wd.check()
+        assert fired == ["pump"]
+
+    def test_threaded_fires_for_real(self):
+        import threading
+
+        fired = threading.Event()
+        wd = HangWatchdog(
+            0.05, lambda phase: fired.set(), warmup_s=0.05, poll_s=0.01
+        )
+        try:
+            with wd.guard("pump"):
+                assert fired.wait(2.0)
+        finally:
+            wd.stop()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HangWatchdog(0.0, lambda p: None, thread=False)
+
+
+# --- supervisor wiring -------------------------------------------------------
+
+
+def _sup(tmp_path, threshold=2, nproc=2, **kw):
+    heal = SelfHealPolicy(
+        threshold, nproc, min_processes=1,
+        probe_after_s=5.0, probe_window_s=3.0,
+    )
+    return DistributedJobSupervisor(
+        ["--checkpointDir", str(tmp_path / "ck")], nproc,
+        run_dir=str(tmp_path / "run"), selfheal=heal, **kw,
+    )
+
+
+class TestSupervisorWiring:
+    def test_selfheal_requires_checkpoint_dir(self, tmp_path):
+        heal = SelfHealPolicy(1, 2)
+        with pytest.raises(ValueError, match="slotStrikes"):
+            DistributedJobSupervisor(
+                ["--trainingData", "x.jsonl"], 2, selfheal=heal,
+                run_dir=str(tmp_path),
+            )
+
+    def test_flags_reject_strikes_without_ckpt(self):
+        from omldm_tpu.runtime.supervisor import supervise_from_flags
+
+        with pytest.raises(SystemExit, match="slotStrikes"):
+            supervise_from_flags({"slotStrikes": "2", "processes": "2"})
+
+    def test_worker_argv_arms_channels_and_gauge(self, tmp_path):
+        sup = _sup(tmp_path)
+        argv = sup._worker_argv(0, 9999, restore=False)
+        assert "--heartbeatDir" in argv
+        assert "--rescaleSignalDir" in argv
+        assert argv[argv.index("--fleetDegraded") + 1] == "0"
+        sup.selfheal.degraded_by = 1
+        sup.nproc = 1
+        argv = sup._worker_argv(0, 9999, restore=True)
+        assert argv[argv.index("--fleetDegraded") + 1] == "1"
+
+    def test_classify_exits_blames_wedged_peer(self, tmp_path):
+        sup = _sup(tmp_path)
+        os.makedirs(sup.hb_dir, exist_ok=True)
+        exc = sup._classify_exits([HANG_EXIT, None], [0])
+        assert exc.failed == [1]
+        assert exc.kinds == {1: HANG}
+        assert "blaming wedged process 1" in exc.cause
+
+    def test_classify_exits_launch_vs_crash(self, tmp_path):
+        sup = _sup(tmp_path)
+        os.makedirs(sup.hb_dir, exist_ok=True)
+        with open(os.path.join(sup.hb_dir, "proc0.hb"), "w") as f:
+            f.write("123.0 0")
+        exc = sup._classify_exits([3, 3], [0, 1])
+        assert exc.kinds == {0: CRASH, 1: LAUNCH}  # proc1 never beat
+        assert exc.kind() == LAUNCH
+
+    def test_strikes_degrade_without_burning_attempts(self, tmp_path):
+        sup = _sup(tmp_path, threshold=2, blackbox_dir=str(tmp_path / "bb"))
+        fail = FleetFailure("p1 died", 3, [1], kinds={1: CRASH})
+        assert sup._note_strikes(fail) is None       # strike 1: restart
+        target = sup._note_strikes(fail)             # strike 2: degrade
+        assert target == 1
+        sup._apply_degrade(fail, target)
+        assert sup.nproc == 1
+        assert sup.failures == []                    # no attempt burned
+        assert [d.to_procs for d in sup.degrades] == [1]
+        kinds = [e["kind"] for e in sup.journal.events]
+        assert kinds.count("strike") == 2
+        assert "degrade" in kinds
+        with open(os.path.join(sup.run_dir, "STRIKES")) as f:
+            assert json.load(f)["degradedBy"] == 1
+
+    def test_hang_exit_code_distinct(self):
+        from omldm_tpu.runtime.supervisor import RESCALE_EXIT
+
+        assert HANG_EXIT not in (0, RESCALE_EXIT,
+                                 DistributedFaultInjector.EXIT_CODE)
+
+
+# --- fault injector: hang + launch refusal -----------------------------------
+
+
+class TestInjectorFaults:
+    def test_hang_sigstops_once_across_incarnations(
+        self, tmp_path, monkeypatch
+    ):
+        stops = []
+        monkeypatch.setattr(
+            "omldm_tpu.runtime.selfheal.sigstop_self",
+            lambda: stops.append(True),
+        )
+        flags = {
+            "hangProcess": "1", "hangAfterChunks": "3",
+            "faultStateDir": str(tmp_path / "fault"),
+        }
+        inj = DistributedFaultInjector(flags, pid=1)
+        inj.on_chunk(1)
+        assert stops == []
+        inj.on_chunk(2)  # chunk_idx+1 == 3: fires
+        assert stops == [True]
+        # a relaunched incarnation re-runs the injector: the marker file
+        # keeps the hang one-shot
+        inj2 = DistributedFaultInjector(flags, pid=1)
+        inj2.on_chunk(5)
+        assert stops == [True]
+
+    def test_hang_other_process_inert(self, tmp_path, monkeypatch):
+        stops = []
+        monkeypatch.setattr(
+            "omldm_tpu.runtime.selfheal.sigstop_self",
+            lambda: stops.append(True),
+        )
+        inj = DistributedFaultInjector(
+            {"hangProcess": "1", "hangAfterChunks": "1"}, pid=0
+        )
+        inj.on_chunk(5)
+        assert stops == []
+
+    def test_launch_refusal_counts_down(self, tmp_path, monkeypatch):
+        died = []
+        monkeypatch.setattr(
+            DistributedFaultInjector, "_die",
+            lambda self, why: died.append(why),
+        )
+        flags = {
+            "refuseLaunchProcess": "0", "refuseLaunchCount": "2",
+            "faultStateDir": str(tmp_path / "fault"),
+        }
+        for _ in range(3):
+            DistributedFaultInjector(flags, pid=0).on_launch()
+        assert len(died) == 2  # third incarnation launches fine
+        DistributedFaultInjector(flags, pid=1).on_launch()
+        assert len(died) == 2  # other slots unaffected
+
+
+# --- dropped-write counters (ENOSPC survival) --------------------------------
+
+
+class TestDroppedWriteCounters:
+    def test_blackbox_dump_counts_not_raises(self, tmp_path):
+        from omldm_tpu.runtime.events import EventJournal
+
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        # the "directory" is a plain file: every dump gets OSError
+        j = EventJournal(cap=8, pid=0, path=str(blocker / "sub"))
+        j.record("terminate", "x")
+        assert j.dump() is None
+        assert j.dump() is None
+        assert j.write_errors == 2
+        assert j.events  # ring intact
+
+    def test_deadletter_counts_not_raises(self, tmp_path):
+        from omldm_tpu.runtime.deadletter import DeadLetterSink
+
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        sink = DeadLetterSink(path=str(blocker / "sub" / "dl.jsonl"))
+        sink.quarantine("training", "{bad", "malformed_json")
+        sink.quarantine("training", "{bad2", "malformed_json")
+        assert sink.write_errors == 1  # degrades once, loudly
+        assert sink.record_count == 2  # in-memory quarantine continues
+
+    def test_heartbeat_returns_false_not_raises(self, tmp_path):
+        from omldm_tpu.runtime.distributed_job import _heartbeat
+
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        assert _heartbeat({"heartbeatDir": str(blocker / "sub")}, 0, 1) is False
+        assert _heartbeat({}, 0, 1) is True  # unarmed: trivially fine
+        ok_dir = tmp_path / "hb"
+        assert _heartbeat({"heartbeatDir": str(ok_dir)}, 0, 1) is True
+
+
+# --- distributed checkpoint integrity (sha256 + generation fallback) ---------
+
+
+jax = pytest.importorskip("jax")
+
+from omldm_tpu.config import JobConfig  # noqa: E402
+from omldm_tpu.runtime.distributed_job import (  # noqa: E402
+    DistributedStreamJob,
+    _file_sha256,
+)
+
+CREATE = json.dumps({
+    "id": 0, "request": "Create",
+    "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                "dataStructure": {"nFeatures": DIM}},
+    "preProcessors": [],
+    "trainingConfiguration": {"protocol": "Synchronous", "syncEvery": 1},
+})
+
+
+def _job():
+    job = DistributedStreamJob(JobConfig(batch_size=8, test_set_size=16))
+    job.sync_requests([CREATE])
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, DIM).astype(np.float32)
+    job.handle_partition_rows(x, (x[:, 0] > 0).astype(np.float32))
+    job.pump()
+    return job
+
+
+class TestCheckpointIntegrity:
+    def test_digests_recorded(self, tmp_path):
+        job = _job()
+        d = job.save_checkpoint(str(tmp_path), 100)
+        manifest = json.load(open(os.path.join(d, "manifest.json")))
+        assert manifest["digests"]["fleet_0.npz"] == _file_sha256(
+            os.path.join(d, "fleet_0.npz")
+        )
+        meta = json.load(open(os.path.join(d, "proc0.json")))
+        assert meta["sha256"] == _file_sha256(os.path.join(d, "proc0.npz"))
+
+    def test_digest_mismatch_rejected(self, tmp_path, capsys):
+        job = _job()
+        d = job.save_checkpoint(str(tmp_path), 100)
+        # same-length corruption: np.load may well decode this fine —
+        # only the digest catches it
+        path = os.path.join(d, "fleet_0.npz")
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert job._validate_checkpoint(d) is None
+        assert "sha256 mismatch" in capsys.readouterr().err
+
+    def test_corrupt_generation_falls_back_to_previous(self, tmp_path):
+        job = _job()
+        job.save_checkpoint(str(tmp_path), 100)
+        d2 = job.save_checkpoint(str(tmp_path), 200)
+        path = os.path.join(d2, "proc0.npz")
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        restored = _job()
+        cur = restored.restore_checkpoint(str(tmp_path))
+        assert cur == 100  # the previous surviving generation
+        assert restored.pipelines  # pipelines redeployed from it
+
+    def test_predigest_snapshots_still_restore(self, tmp_path):
+        job = _job()
+        d = job.save_checkpoint(str(tmp_path), 100)
+        # strip the digests (an old-format snapshot): load checks remain
+        manifest = json.load(open(os.path.join(d, "manifest.json")))
+        manifest.pop("digests")
+        json.dump(manifest, open(os.path.join(d, "manifest.json"), "w"))
+        meta = json.load(open(os.path.join(d, "proc0.json")))
+        meta.pop("sha256")
+        json.dump(meta, open(os.path.join(d, "proc0.json"), "w"))
+        restored = _job()
+        assert restored.restore_checkpoint(str(tmp_path)) == 100
+
+
+class TestRecoveryGenerationFallback:
+    def _ckpt_job(self, tmp_path):
+        from omldm_tpu.runtime import StreamJob
+        from omldm_tpu.runtime.job import REQUEST_STREAM, TRAINING_STREAM
+
+        cfg = JobConfig(
+            parallelism=2, batch_size=16, test_set_size=16,
+            checkpointing=True, checkpoint_dir=str(tmp_path / "ck"),
+            check_interval_ms=0,
+        )
+        job = StreamJob(cfg)
+        rng = np.random.RandomState(0)
+        events = [(REQUEST_STREAM, json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+            "trainingConfiguration": {"protocol": "Synchronous",
+                                      "syncEvery": 2},
+        }))] + [
+            (TRAINING_STREAM, json.dumps({
+                "numericalFeatures": [float(v) for v in rng.randn(5)],
+                "target": 1.0,
+            }))
+            for _ in range(64)
+        ]
+        return job, events
+
+    def test_torn_latest_falls_back(self, tmp_path):
+        from omldm_tpu.runtime.recovery import recover_job
+
+        job, events = self._ckpt_job(tmp_path)
+        for stream, payload in events:
+            job.process_event(stream, payload)
+            job.checkpoint_manager.maybe_save(job)
+        candidates = job.checkpoint_manager.candidate_paths()
+        assert len(candidates) >= 2
+        # torn newest generation (truncated pickle)
+        with open(candidates[0], "r+b") as f:
+            f.truncate(os.path.getsize(candidates[0]) // 2)
+        recovered, path = recover_job(job)
+        assert path == candidates[1]  # the previous surviving generation
+        assert recovered.events_processed > 0
+
+    def test_all_torn_degrades_to_fresh(self, tmp_path):
+        from omldm_tpu.runtime.recovery import recover_job
+
+        job, events = self._ckpt_job(tmp_path)
+        for stream, payload in events:
+            job.process_event(stream, payload)
+            job.checkpoint_manager.maybe_save(job)
+        for c in job.checkpoint_manager.candidate_paths():
+            with open(c, "r+b") as f:
+                f.truncate(1)
+        recovered, path = recover_job(job)
+        assert path is None
+        assert recovered.events_processed == 0  # fresh, offset 0
+
+
+# --- the full loop (slow) ----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_selfheal_sigstop_degrade_probe_heal(tmp_path):
+    """A SIGSTOP'd worker wedges its peer's collective: the survivor exits
+    HANG_EXIT within --collectiveTimeoutMs, the supervisor blames the
+    silent slot, shrinks the fleet 2 -> 1 through restore-with-rescale
+    (exact row conservation, exactly-once forecasts), probes back to 2
+    once quiet, and heals — with the classify -> strike -> degrade ->
+    probe chain journaled."""
+    sys.path.insert(0, TESTS)
+    import fskafka
+
+    broker = tmp_path / "broker"
+    os.environ["FSKAFKA_DIR"] = str(broker)
+    n_rows, n_fore = 6000, 0
+    try:
+        rng = np.random.RandomState(0)
+        w = rng.randn(12)
+        for i in range(n_rows):
+            x = np.round(rng.randn(12), 6)
+            if i % 20 == 0:
+                n_fore += 1
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "operation": "forecasting",
+                })
+            else:
+                line = json.dumps({
+                    "numericalFeatures": [float(v) for v in x],
+                    "target": float(x @ w > 0), "operation": "training",
+                })
+            fskafka.append("trainingData", line, partition=i % 4)
+        fskafka.append("requests", json.dumps({
+            "id": 0, "request": "Create",
+            "learner": {"name": "PA", "hyperParameters": {"C": 1.0},
+                        "dataStructure": {"nFeatures": 12}},
+            "trainingConfiguration": {
+                "protocol": "Synchronous", "syncEvery": 1,
+            },
+        }))
+    finally:
+        os.environ.pop("FSKAFKA_DIR", None)
+
+    perf = tmp_path / "perf.jsonl"
+    preds = tmp_path / "preds.jsonl"
+    blackbox = tmp_path / "blackbox"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FSKAFKA_DIR"] = str(broker)
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu.runtime.distributed_job",
+         "--supervise", "true", "--processes", "2",
+         "--slotStrikes", "1", "--minProcesses", "1",
+         "--probeAfterMs", "2000", "--probeWindowMs", "1500",
+         "--collectiveTimeoutMs", "5000",
+         "--killDeadlineMs", "1000",
+         "--hangProcess", "1", "--hangAfterChunks", "6",
+         "--faultStateDir", str(tmp_path / "fault"),
+         "--flightRecorder", "on", "--blackboxPath", str(blackbox),
+         "--kafkaBrokers", "fs://local", "--workerBoot", FSKAFKA_BOOT,
+         "--checkpointDir", str(tmp_path / "ckpts"),
+         "--checkpointEvery", "2",
+         "--chunkRows", "100", "--kafkaPollMs", "50",
+         "--idleWindows", "60",
+         "--batchSize", "64", "--testSetSize", "32",
+         "--restartAttempts", "2", "--restartDelayMs", "50",
+         "--performanceOut", str(perf), "--predictionsOut", str(preds)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    err = out.stderr
+    # the chain, in the log
+    assert "injected hang: SIGSTOP" in err
+    assert "collective watchdog: no progress" in err  # survivor HANG_EXIT
+    assert "blaming wedged process 1" in err
+    assert "degrading fleet 2 -> 1" in err
+    assert "redistributing a 2-process snapshot" in err
+    assert "probing back 1 -> 2" in err
+    assert "re-expansion probe" in err
+    assert "fleet healed at 2" in err
+    # conservation + exactly-once across hang, degrade and probe
+    report = json.loads(perf.read_text().strip())
+    [s] = report["statistics"]
+    assert s["fitted"] + report["holdout"]["0"] == n_rows - n_fore
+    # the fleet finishes at width 2: per-process prediction files
+    pred_files = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("preds.jsonl")
+    )
+    payloads = [
+        json.loads(l)
+        for f in pred_files
+        for l in open(tmp_path / f).read().splitlines()
+    ]
+    assert len(payloads) == n_fore
+    assert report["fleetProcesses"] == 2   # back at full width
+    assert report["fleetDegraded"] == 0    # healed
+    # the run-end bundle carries the decision chain in causal order
+    bundles = sorted(
+        f for f in os.listdir(blackbox) if f.startswith("incident-")
+    )
+    assert bundles
+    final = json.load(open(blackbox / bundles[-1]))
+    kinds = [e["kind"] for e in final["timeline"]]
+    chain = [k for k in kinds if k in ("strike", "degrade", "probe")]
+    assert chain[:3] == ["strike", "degrade", "probe"]
+    # the worker-side hang event survives in a bundle (the degrade-time
+    # gather, before the relaunch overwrote the rings)
+    all_kinds = set()
+    for b in bundles:
+        all_kinds.update(
+            e["kind"] for e in json.load(open(blackbox / b))["timeline"]
+        )
+    assert "hang" in all_kinds
