@@ -42,16 +42,25 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "ben
 def _ensure_reachable_backend() -> str:
     """The axon TPU tunnel can WEDGE (client init hangs instead of
     erroring); probe it in a killable subprocess and fall back to CPU so
-    the benchmark always produces its JSON line."""
+    the benchmark always produces its JSON line. Returns the TRUE
+    platform the run will execute on (``jax.default_backend()``), not a
+    reachability verdict — a reachable-but-CPU-only jax is still an
+    off-accelerator run and must be stamped as one."""
     import subprocess
 
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            [
+                sys.executable, "-c",
+                "import jax; jax.devices(); print('bk:'"
+                " + jax.default_backend())",
+            ],
             capture_output=True, text=True, timeout=150,
         )
-        if proc.returncode == 0 and "ok" in proc.stdout:
-            return "default"
+        if proc.returncode == 0:
+            for line in proc.stdout.splitlines():
+                if line.startswith("bk:"):
+                    return line[3:].strip()
     except subprocess.TimeoutExpired:
         pass
     import jax
@@ -60,7 +69,18 @@ def _ensure_reachable_backend() -> str:
     return "cpu-fallback (accelerator unreachable)"
 
 
-def main() -> None:
+# exit code when the run executed off-accelerator (the tunnel wedged and
+# we fell back, OR jax's true default backend is plain CPU): the JSON
+# record still prints (the numbers are real, the backend field says what
+# they measure), but the process exits nonzero so a chip harness that
+# EXPECTED accelerator numbers fails loudly instead of silently recording
+# host-fallback figures as if they were device runs
+FALLBACK_EXIT = 3
+
+ACCELERATOR_BACKENDS = ("tpu", "gpu", "cuda", "rocm")
+
+
+def main() -> int:
     backend = _ensure_reachable_backend()
     from run_benchmarks import bench_e2e_stream
 
@@ -80,7 +100,15 @@ def main() -> None:
             }
         )
     )
+    if backend not in ACCELERATOR_BACKENDS:
+        print(
+            f"WARNING: off-accelerator run (backend={backend}); numbers "
+            "are host-pipeline figures, exiting nonzero",
+            file=sys.stderr,
+        )
+        return FALLBACK_EXIT
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

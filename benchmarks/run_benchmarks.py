@@ -1070,6 +1070,36 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
     float(np.asarray(bridge_r.trainer.global_flat_params()[0]))
     t_raw_overlapped = time.perf_counter() - t0
 
+    # --- sharded ingest leg (ISSUE 17): N parser processes striping the
+    # file's byte-grid chunks, the driver consuming blocks in stream
+    # order through shared-memory rings (bit-identical row order). Same
+    # stubbed-device basis as t_host, so the ratio is the ingest plane's
+    # own scaling — on a 1-core host the extra processes just timeshare
+    # and the ratio reports the (honest) IPC overhead instead.
+    from omldm_tpu.runtime.ingest_shard import IngestConfig, ShardedIngest
+
+    n_cores = os.cpu_count() or 1
+    n_shards = max(n_cores - 1, 1)
+    job_s, bridge_s = _make_e2e_job(dim, parallelism, chain)
+    bridge_s.trainer = _NopTrainer()
+
+    def _sharded_pass():
+        si = ShardedIngest(tmp.name, dim, IngestConfig(shards=n_shards))
+        try:
+            for block in si.blocks():
+                bridge_s.handle_batch(*block)
+        finally:
+            si.close()
+        bridge_s.flush()
+
+    _sharded_pass()  # warmup (fork + ring setup paths)
+    sharded_samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sharded_pass()
+        sharded_samples.append(time.perf_counter() - t0)
+    t_sharded = min(sharded_samples)
+
     # --- phase-attributed breakdown of the streaming host run (ISSUE 13):
     # the same stream through the telemetry-armed packed host route, so
     # the e2e number above ships with measured per-phase attribution
@@ -1095,6 +1125,17 @@ def bench_e2e_stream(n_records=1_000_000, parallelism=1, chain=32):
         "host_pipeline_examples_per_sec": round(n_records / t_host, 1),
         "device_exec_examples_per_sec": round(1.0 / t_dev_per_rec, 1),
         "host_samples_s": [round(t, 3) for t in host_samples],
+        "sharded_ingest_examples_per_sec": round(n_records / t_sharded, 1),
+        "sharded_samples_s": [round(t, 3) for t in sharded_samples],
+        "sharded_shards": n_shards,
+        "sharded_host_cores": n_cores,
+        "sharded_vs_single": round(t_host / t_sharded, 3),
+        "sharded_basis": (
+            "driver-visible, device stubbed (same basis as t_host); "
+            "shards = cores-1; on a 1-core host the shards timeshare the "
+            "driver's core, so the ratio measures IPC overhead, not "
+            "scaling"
+        ),
         "ingest_route": "fused-c" if use_fused else "packed-numpy",
         "t_host_s": round(t_host, 3),
         "t_device_s": round(t_device, 3),

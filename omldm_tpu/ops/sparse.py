@@ -179,20 +179,27 @@ def _resolve_impl(d: int, n_updates: int, impl=None) -> str:
     (``impl`` argument, from dataStructure.scatterImpl), the
     OMLDM_SPARSE_SCATTER env var, the persisted calibration table
     (ops/sparse_dispatch.json, nearest (D, updates) grid point for this
-    backend), and only then the pre-calibration guess.
+    backend), and only then the uncalibrated fallback: ``scatter``.
 
-    The guess documents the measured record so far: XLA's TPU scatter
-    serializes at ~66M updates/s regardless of D
-    (benchmarks/sparse_scatter_experiment.py), and the MXU reformulation
-    costs ~2*2*D FLOPs per update — at D >= 2^16 on a v5e-class MXU the
-    contraction clears the serialized scatter, below it the one-hot FLOPs
-    dominate. On CPU the committed table (generated by
-    ``python -m omldm_tpu.ops.sparse_calibrate`` on this host) measures
-    the plain scatter fastest through D = 2^18 (12-17M updates/s), but at
-    D = 2^20 the scatter drops to ~8M as the target array falls out of
-    cache and the segsum pre-combine (~10M, D-independent) wins 3 of 4
-    grid points; the MXU formulation never wins off-TPU. Re-calibrate
-    with ``sparse_calibrate --out`` after hardware changes.
+    The round-5 ``D >= 2^16 -> mxu`` TPU guess is RETIRED (never
+    validated: every calibration attempt against this environment's TPU
+    wedges in client init — the tunnel serializes and hangs, see
+    ops/sparse_dispatch.json "tpu_status" — so the guessed crossover was
+    a number nobody ever measured). An uncalibrated backend now gets the
+    plain scatter, the only formulation with a measured record on every
+    backend we have touched; the first real
+    ``python -m omldm_tpu.ops.sparse_calibrate`` run on a reachable chip
+    writes the table section that makes the mxu/segsum formulations
+    eligible there. The physics behind the old guess still stands as a
+    hypothesis (XLA's TPU scatter serializes at ~66M updates/s
+    regardless of D, benchmarks/sparse_scatter_experiment.py, while the
+    MXU reformulation costs ~2*2*D FLOPs per update), but a hypothesis
+    is what the calibration table exists to test, not to hardcode. On
+    CPU the committed table measures the plain scatter fastest through
+    D = 2^18 (12-17M updates/s); at D = 2^20 the scatter drops to ~8M as
+    the target array falls out of cache and the segsum pre-combine
+    (~10M, D-independent) wins 3 of 4 grid points; the MXU formulation
+    never wins off-TPU.
     """
     if impl:
         name = str(impl)
@@ -215,10 +222,9 @@ def _resolve_impl(d: int, n_updates: int, impl=None) -> str:
     winner = lookup_winner(jax.default_backend(), d, n_updates)
     if winner is not None:
         return winner
-    # pre-calibration fallback: the round-5 guess, kept only for hosts
-    # with no table entry for their backend
-    if jax.default_backend() == "tpu" and d >= (1 << 16):
-        return "mxu"
+    # uncalibrated backend: plain scatter until a real calibration run
+    # writes this backend's table section (the round-5 D>=2^16 mxu guess
+    # is retired — see the docstring)
     return "scatter"
 
 

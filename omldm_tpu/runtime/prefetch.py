@@ -111,6 +111,19 @@ class Prefetcher(Iterator[T]):
         ahead of a stalled consumer."""
         return self._q.qsize() / self._q.maxsize
 
+    def as_signal(self, high: float = 0.75, critical: float = 0.95):
+        """Occupancy as an ``OverloadController.extra_signals`` probe for
+        the ingest plane's backpressure: the reported value is ring
+        EMPTINESS (1 - occupancy), so a source that cannot keep the ring
+        fed — a slow parser shard — raises the overload level instead of
+        silently starving the driver. Thresholds are emptiness fractions:
+        value >= high elevates, >= critical is critical."""
+
+        def probe():
+            return 1.0 - self.occupancy(), high, critical
+
+        return probe
+
 
 def prefetch(source: Iterable[T], depth: int = 2) -> Prefetcher[T]:
     """Back-compat constructor: iterate ``source`` on a daemon thread,

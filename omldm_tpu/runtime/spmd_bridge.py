@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from omldm_tpu.api.data import FORECASTING, DataInstance, Prediction
@@ -36,6 +37,190 @@ from omldm_tpu.runtime.vectorizer import F32_MAX, Vectorizer
 # flush remainders pad to this sub-batch instead of a full dp*B group
 # (a 1-row tail no longer ships half a megabyte of zeros)
 TAIL_BATCH = 256
+
+
+def _resident_absorb(sx, sy, hx, hy, bx, by, ev_slot, ev_dst, keep_src,
+                     keep_dst, hold_dst):
+    """One device-resident ingest segment: gather the holdout rows the
+    segment evicts (before their slots are overwritten), scatter them and
+    the kept rows into the stage at their stream-order ranks, and scatter
+    the segment's test rows into their holdout ring slots. All index
+    arrays are host-computed; padding lanes carry out-of-range
+    destinations, which ``mode="drop"`` discards."""
+    sx = sx.at[ev_dst].set(hx[ev_slot], mode="drop")
+    sy = sy.at[ev_dst].set(hy[ev_slot], mode="drop")
+    sx = sx.at[keep_dst].set(bx[keep_src], mode="drop")
+    sy = sy.at[keep_dst].set(by[keep_src], mode="drop")
+    hx = hx.at[hold_dst].set(bx, mode="drop")
+    hy = hy.at[hold_dst].set(by, mode="drop")
+    return sx, sy, hx, hy
+
+
+def _resident_seg_rows(hold_cap: int, test_enabled: bool) -> int:
+    """Segment width for the resident kernel. Scatter destinations must be
+    distinct within one call, so a segment may not carry more test rows
+    than the holdout ring holds; the worst case over cycle phases for a
+    window of m rows is 2*(m//10) + min(m%10, 2)."""
+    if not test_enabled:
+        return 4096
+    m = 5 * hold_cap
+    while m > 1 and (2 * (m // 10) + min(m % 10, 2)) > hold_cap:
+        m -= 1
+    return max(m, 1)
+
+
+class _ResidentIngest:
+    """Device-resident stage + holdout for :class:`SPMDBridge`.
+
+    When armed (``JobConfig.ingest`` with ``device:on``), the staging pad
+    and the holdout ring live as jax arrays; the host computes only the
+    O(n) index arithmetic per block (the exact ``_train_rows`` /
+    ``ArrayHoldout.append_many`` semantics, counters stay host-side) and
+    one jitted gather/scatter moves the rows. A full stage launches
+    ``step_many_dense`` directly on the resident arrays — no host staging
+    copy, no per-batch holdout filtering on the host. Partial drains
+    (flush/snapshot) sync back through the bridge's ordinary host path so
+    the fitted/holdout row order stays bit-identical to the unarmed
+    route."""
+
+    def __init__(self, bridge: "SPMDBridge"):
+        self.bridge = bridge
+        self.seg = _resident_seg_rows(
+            bridge.test_set.max_size, bool(bridge.config.test)
+        )
+        self.sx = jnp.zeros((bridge._stage_cap, bridge.dim), jnp.float32)
+        self.sy = jnp.zeros((bridge._stage_cap,), jnp.float32)
+        self.hx = jnp.asarray(bridge.test_set._x)
+        self.hy = jnp.asarray(bridge.test_set._y)
+        self._kernel = jax.jit(_resident_absorb, donate_argnums=(0, 1, 2, 3))
+
+    # --- hot path ---
+
+    def absorb(self, x: np.ndarray, y: np.ndarray) -> None:
+        """Resident twin of ``_train_rows`` + ``_stage_rows``: identical
+        holdout cycle, eviction order, and stage fill order, with the row
+        movement on device."""
+        br = self.bridge
+        ts = br.test_set
+        n = x.shape[0]
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.ascontiguousarray(y, np.float32)
+        cap = br._stage_cap
+        H = ts.max_size
+        i = 0
+        while i < n:
+            m = min(self.seg, n - i)
+            if br.config.test:
+                c = (br.holdout_count + np.arange(m)) % 10
+                test_mask = c >= 8
+                # a test row emits a train row only once the ring is full
+                # at its turn (it evicts the oldest holdout point)
+                free = H - ts._n
+                emits = np.where(test_mask, np.cumsum(test_mask) > free, True)
+            else:
+                test_mask = np.zeros(m, bool)
+                emits = np.ones(m, bool)
+            train_cum = np.cumsum(emits)
+            room = cap - br._stage_n
+            if train_cum.size and train_cum[-1] > room:
+                # split where the stage fills exactly; trailing rows that
+                # emit nothing may ride along (harmless), emitters may not
+                m = int(np.searchsorted(train_cum, room, side="right"))
+                test_mask = test_mask[:m]
+            t_idx = np.nonzero(test_mask)[0]
+            keep_idx = np.nonzero(~test_mask)[0]
+            fill = min(H - ts._n, t_idx.size)
+            k2 = t_idx.size - fill
+            head = ts._head
+            slot_fill = (head + ts._n + np.arange(fill)) % H
+            slot_ev = (head + np.arange(k2)) % H
+            hold_dst = np.full(self.seg, H, np.int32)
+            hold_dst[t_idx[:fill]] = slot_fill
+            hold_dst[t_idx[fill:]] = slot_ev
+            # evicted points re-enter training at the evicting row's slot:
+            # same stable order as _train_rows' argsort re-merge
+            pos = np.concatenate([keep_idx, t_idx[fill:]])
+            order = np.argsort(pos, kind="stable")
+            rank = np.empty(pos.size, np.int64)
+            rank[order] = np.arange(pos.size)
+            base = br._stage_n
+            keep_src = np.zeros(self.seg, np.int32)
+            keep_dst = np.full(self.seg, cap, np.int32)
+            keep_src[: keep_idx.size] = keep_idx
+            keep_dst[: keep_idx.size] = base + rank[: keep_idx.size]
+            ev_slot = np.zeros(self.seg, np.int32)
+            ev_dst = np.full(self.seg, cap, np.int32)
+            ev_slot[:k2] = slot_ev
+            ev_dst[:k2] = base + rank[keep_idx.size :]
+            bx = np.zeros((self.seg, br.dim), np.float32)
+            by = np.zeros((self.seg,), np.float32)
+            bx[:m] = x[i : i + m]
+            by[:m] = y[i : i + m]
+            self.sx, self.sy, self.hx, self.hy = self._kernel(
+                self.sx, self.sy, self.hx, self.hy,
+                bx, by, ev_slot, ev_dst, keep_src, keep_dst, hold_dst,
+            )
+            ts._n += fill
+            ts._head = (head + k2) % H
+            br.holdout_count += m
+            br._stage_n = base + pos.size
+            if br._stage_n >= cap:
+                self._launch_full()
+            i += m
+
+    def _launch_full(self) -> None:
+        br = self.bridge
+        b = br.config.batch_size
+        xs = self.sx.reshape(br.chain, br.dp, b, br.dim)
+        ys = self.sy.reshape(br.chain, br.dp, b)
+        br.trainer.step_many_dense(xs, ys)
+        br._stage_n = 0
+
+    # --- drains / sync (rare paths go through the host route) ---
+
+    def drain_to_host(self) -> None:
+        """Flush a partial stage through the bridge's host tail path
+        (whole [dp, B] groups + padded TAIL_BATCH remainder) so partial
+        launches are bit-identical to the unarmed route."""
+        br = self.bridge
+        n = br._stage_n
+        br._stage_n = 0
+        if n == 0:
+            return
+        br._train_buffer(np.asarray(self.sx[:n]), np.asarray(self.sy[:n]), n)
+
+    def sync_host(self) -> None:
+        """Copy the resident holdout/stage back into the host mirrors
+        (checkpoint snapshots read them)."""
+        br = self.bridge
+        ts = br.test_set
+        ts._x[...] = np.asarray(self.hx)
+        ts._y[...] = np.asarray(self.hy)
+        n = br._stage_n
+        br._stage_x[:n] = np.asarray(self.sx[:n])
+        br._stage_y[:n] = np.asarray(self.sy[:n])
+
+    def push_from_host(self) -> None:
+        """Re-upload the host mirrors (checkpoint restore writes them)."""
+        br = self.bridge
+        self.hx = jnp.asarray(br.test_set._x)
+        self.hy = jnp.asarray(br.test_set._y)
+        self.sx = jnp.asarray(br._stage_x, jnp.float32)
+        self.sy = jnp.asarray(br._stage_y, jnp.float32)
+
+    def eval_arrays(self):
+        """Holdout eval inputs straight from the resident ring — same
+        oldest-first order and zero padding as ``ArrayHoldout.arrays`` +
+        the host pad, without the device round trip."""
+        ts = self.bridge.test_set
+        cap = ts.max_size
+        idx = jnp.asarray((ts._head + np.arange(cap)) % cap)
+        mask = jnp.asarray(
+            (np.arange(cap) < ts._n).astype(np.float32)
+        )
+        xs = jnp.where(mask[:, None] > 0, self.hx[idx], 0.0)
+        ys = jnp.where(mask > 0, self.hy[idx], 0.0)
+        return xs, ys, mask
 
 
 def spmd_engine_requested(request: Request) -> bool:
@@ -222,6 +407,8 @@ class SPMDBridge:
         self._stage_x = np.zeros((self._stage_cap, dim), self.feed_dtype)
         self._stage_y = np.zeros((self._stage_cap,), self.feed_dtype)
         self._stage_n = 0
+        # armed by enable_resident_ingest() (JobConfig.ingest device:on)
+        self._resident: Optional[_ResidentIngest] = None
 
     # --- data path ---
 
@@ -240,16 +427,9 @@ class SPMDBridge:
             else min(max(float(inst.target), -F32_MAX), F32_MAX)
         )
         # 20% holdout: counts 8,9 of each 0-9 cycle (FlinkSpoke.scala:94-104)
-        c = self.holdout_count % 10
-        self.holdout_count += 1
-        if self.config.test and c >= 8:
-            ev_x, ev_y, _ = self.test_set.append_many(
-                x[None, :], np.asarray([y], np.float32)
-            )
-            if ev_x.shape[0] == 0:
-                return
-            x, y = ev_x[0], float(ev_y[0])
-        self._stage_rows(x[None, :], np.asarray([y], np.float32))
+        # — the single-record case of _train_rows (which also routes through
+        # the resident stage when armed)
+        self._train_rows(x[None, :], np.asarray([y], np.float32))
 
     def handle_batch(
         self, x: np.ndarray, y: np.ndarray, op: np.ndarray
@@ -295,6 +475,9 @@ class SPMDBridge:
         n = x.shape[0]
         if n == 0:
             return
+        if self._resident is not None:
+            self._resident.absorb(x, y)
+            return
         if self.config.test:
             c = (self.holdout_count + np.arange(n)) % 10
             self.holdout_count += n
@@ -329,6 +512,9 @@ class SPMDBridge:
 
     def _train_staged(self, full: bool = False) -> None:
         """Launch the staged rows of the bridge's own stage buffer."""
+        if self._resident is not None:
+            self._resident.drain_to_host()
+            return
         n = self._stage_n
         self._stage_n = 0
         self._train_buffer(self._stage_x, self._stage_y, n, full)
@@ -443,6 +629,8 @@ class SPMDBridge:
 
     def snapshot_buffers(self) -> dict:
         """Holdout + staged rows for a job checkpoint."""
+        if self._resident is not None:
+            self._resident.sync_host()
         test_x, test_y = self.test_set.arrays()
         return {
             "test_x": test_x.copy(),
@@ -456,6 +644,16 @@ class SPMDBridge:
         }
 
     def restore_buffers(self, bd: dict) -> None:
+        if self._resident is not None:
+            # restore on the host mirrors (the rare path), then re-upload
+            res, self._resident = self._resident, None
+            res.sync_host()
+            try:
+                self.restore_buffers(bd)
+            finally:
+                self._resident = res
+                res.push_from_host()
+            return
         if bd["test_x"].shape[0]:
             self.test_set.append_many(bd["test_x"], bd["test_y"])
         if bd["stage_x"].shape[0]:
@@ -466,10 +664,38 @@ class SPMDBridge:
     def supports_fused_ingest(self) -> bool:
         """The fused C loop writes float32 rows straight into the staging
         buffers; fp16 feeds and missing-toolchain hosts use the packed
-        numpy route instead."""
+        numpy route instead. A resident stage lives on device — the C loop
+        cannot write it, so the packed route (which feeds _train_rows and
+        thereby the resident kernel) carries those jobs."""
         from omldm_tpu.ops.native import fast_parser_available
 
-        return self.feed_dtype == np.float32 and fast_parser_available()
+        return (
+            self.feed_dtype == np.float32
+            and self._resident is None
+            and fast_parser_available()
+        )
+
+    # --- device-resident stage/holdout (JobConfig.ingest device:on) ---
+
+    def supports_resident_ingest(self) -> bool:
+        """Resident stage/holdout needs the chained mask-free launch path:
+        float32 feed, no SSP pacing (refused rows must re-enter a host
+        stage)."""
+        return self.feed_dtype == np.float32 and not self._paced
+
+    def enable_resident_ingest(self) -> bool:
+        """Arm the device-resident stage + holdout ring. Returns False
+        (and stays on the host route) for bridges the resident path cannot
+        serve. Safe to call before any data flows; arming mid-stream would
+        strand staged host rows, so it is refused then."""
+        if self._resident is not None:
+            return True
+        if not self.supports_resident_ingest():
+            return False
+        if self._stage_n or len(self.test_set):
+            return False
+        self._resident = _ResidentIngest(self)
+        return True
 
     def _fused_stage(self):
         from omldm_tpu.ops.native import FusedStage
@@ -666,6 +892,10 @@ class SPMDBridge:
     def _evaluate(self) -> Tuple[float, float]:
         if self.test_set.is_empty:
             return 0.0, 0.0
+        if self._resident is not None:
+            # serve the eval straight from the resident holdout ring
+            xs, ys, mask = self._resident.eval_arrays()
+            return self.trainer.evaluate(xs, ys, mask)
         xs, ys = self.test_set.arrays()
         # pad to the holdout capacity so the jitted eval program compiles
         # once, not once per fill level while the holdout warms up

@@ -52,9 +52,15 @@ import numpy as np
 
 # canonical hot-loop phase names (the bench.py breakdown table's rows);
 # PhaseProfile accepts any name — these are the ones the runtime wires
+# NOTE: the sharded ingest plane (runtime/ingest_shard.py) folds its
+# worker-process parse clocks into "parse" and the driver's ring-wait
+# into "read" at the end of a run_file_sharded pass — worker seconds are
+# summed ACROSS shard processes, so on a multi-core host "parse" can
+# legitimately exceed the driver's wall time (parallel work attributed
+# to one table).
 PHASES = (
-    "read",        # source I/O: kafka poll / file block read
-    "parse",       # bytes -> rows (JSON parse, C block parse)
+    "read",        # source I/O: kafka poll / file block read / shard ring
+    "parse",       # bytes -> rows (JSON parse, C block parse, shard procs)
     "stage",       # rows -> fixed-shape micro-batches (vectorize + batcher)
     "holdout",     # 8-of-10 test-set split bookkeeping
     "fit",         # training program dispatch (the StepTimer flush path)

@@ -1,0 +1,514 @@
+"""Sharded multi-process ingest plane (runtime/ingest_shard.py) + the
+device-resident hot loop (spmd_bridge._ResidentIngest).
+
+Pins the ISSUE 17 contracts:
+- the sharded block stream is BITWISE the single-process parse, for any
+  shard count / chunk size (block boundaries carry no semantics);
+- the interleave is deterministic under seeded worker chaos: a parser
+  killed (or wedged) mid-stream degrades to in-process parsing from the
+  exact row the sharded stream stopped at, reason-coded with the
+  selfheal failure class — the consumed row sequence never changes;
+- unarmed identity: an empty ``ingest`` spec is the exact pre-plane
+  route (run_file dispatches to the fused path, no worker processes);
+- the device-resident path is bit-identical to the host stage/holdout
+  path and refuses to arm when it could not be (SSP pacing, mid-stream);
+- the backpressure probes (driver starvation, prefetch emptiness) wire
+  into the overload plane's extra_signals and detach cleanly.
+"""
+
+import json
+import os
+import signal
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from omldm_tpu.config import JobConfig
+from omldm_tpu.runtime.fast_ingest import iter_file_batches
+from omldm_tpu.runtime.ingest_shard import (
+    IngestConfig,
+    ShardedIngest,
+    chunk_span,
+    n_chunks,
+    parse_ingest_spec,
+)
+from omldm_tpu.runtime.selfheal import CRASH, HANG
+
+
+def _write_stream(path, n, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    x = rng.randn(n, dim)
+    y = (x @ w > 0).astype(np.float64)
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "numericalFeatures": list(np.round(x[i], 5)),
+                "target": float(y[i]),
+            }) + "\n")
+
+
+@pytest.fixture(scope="module")
+def stream_file():
+    tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+    tmp.close()
+    _write_stream(tmp.name, 3000, dim=6)
+    yield tmp.name, 6, 3000
+    os.unlink(tmp.name)
+
+
+def _reference_rows(path, dim):
+    parts = list(iter_file_batches(path, dim, 8192))
+    return tuple(np.concatenate([p[i] for p in parts]) for i in range(3))
+
+
+def _sharded_rows(si):
+    xs, ys, ops = [], [], []
+    for x, y, op in si.blocks():
+        xs.append(x)
+        ys.append(y)
+        ops.append(op)
+    return (
+        np.concatenate(xs) if xs else np.zeros((0, si.dim), np.float32),
+        np.concatenate(ys) if ys else np.zeros((0,), np.float32),
+        np.concatenate(ops) if ops else np.zeros((0,), np.uint8),
+    )
+
+
+# --- spec parsing --------------------------------------------------------
+
+
+def test_spec_unarmed_forms():
+    assert parse_ingest_spec(None) is None
+    assert parse_ingest_spec("") is None
+    assert parse_ingest_spec(False) is None
+
+
+def test_spec_on_arms_default_shape():
+    cfg = parse_ingest_spec("on")
+    assert cfg is not None
+    assert cfg.shards >= 1  # one parser per spare core
+    assert cfg.device is False
+    assert parse_ingest_spec(True) is not None
+
+
+def test_spec_knobs():
+    cfg = parse_ingest_spec(
+        "shards=2, chunkKb=256, ring=3, slotRows=500, device=on, waitMs=750"
+    )
+    assert (cfg.shards, cfg.chunk_kb, cfg.ring, cfg.slot_rows) == (
+        2, 256, 3, 500,
+    )
+    assert cfg.device is True
+    assert cfg.wait_ms == 750.0
+    # dict form (embedded config tables)
+    cfg = parse_ingest_spec({"shards": 1, "device": "false"})
+    assert cfg.shards == 1 and cfg.device is False
+
+
+def test_spec_validation_fails_fast():
+    with pytest.raises(ValueError, match="unknown ingest knob"):
+        parse_ingest_spec("shards=2,bogus=1")
+    with pytest.raises(ValueError, match="want k=v"):
+        parse_ingest_spec("junk")
+    with pytest.raises(ValueError, match="ring"):
+        parse_ingest_spec("ring=0")
+    with pytest.raises(ValueError, match="shards"):
+        parse_ingest_spec("shards=-1")
+    with pytest.raises(ValueError, match="table"):
+        parse_ingest_spec(3.5)
+
+
+def test_bad_spec_raises_at_job_construction():
+    from omldm_tpu.runtime import StreamJob
+
+    with pytest.raises(ValueError, match="unknown ingest knob"):
+        StreamJob(JobConfig(parallelism=1, ingest="nope=1"))
+
+
+# --- deterministic chunk grid --------------------------------------------
+
+
+def test_chunk_spans_partition_file(stream_file):
+    path, _, _ = stream_file
+    fsize = os.path.getsize(path)
+    for chunk_kb in (1, 4, 64):
+        cb = chunk_kb * 1024
+        spans = []
+        with open(path, "rb") as f:
+            for k in range(n_chunks(fsize, cb)):
+                span = chunk_span(f, k, cb, fsize)
+                assert span is not None
+                spans.append(span)
+            assert chunk_span(f, n_chunks(fsize, cb), cb, fsize) is None
+        # contiguous, non-overlapping, covering [0, fsize)
+        assert spans[0][0] == 0
+        assert spans[-1][1] == fsize
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 == b0
+            assert a0 <= a1
+
+
+def test_chunk_span_line_longer_than_chunk():
+    """A line spanning several grid windows: interior chunks are empty
+    spans, the line belongs to the chunk holding its first byte."""
+    tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+    tmp.close()
+    try:
+        dim = 400  # one line is several KB > the 1 KB chunk grid
+        _write_stream(tmp.name, 12, dim=dim)
+        ref = _reference_rows(tmp.name, dim)
+        si = ShardedIngest(
+            tmp.name, dim, IngestConfig(shards=2, chunk_kb=1)
+        )
+        got = _sharded_rows(si)
+        for a, b in zip(ref, got):
+            assert np.array_equal(a, b)
+    finally:
+        os.unlink(tmp.name)
+
+
+# --- bit-identity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards,chunk_kb", [(1, 64), (2, 16), (3, 7)])
+def test_sharded_stream_bitwise_single_process(stream_file, shards, chunk_kb):
+    path, dim, n = stream_file
+    ref = _reference_rows(path, dim)
+    assert ref[0].shape[0] == n
+    si = ShardedIngest(
+        path, dim, IngestConfig(shards=shards, chunk_kb=chunk_kb)
+    )
+    got = _sharded_rows(si)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+    st = si.stats()
+    assert st["rows"] == n
+    assert st["workers"] == shards
+    assert st["chunks"] == n_chunks(os.path.getsize(path), chunk_kb * 1024)
+    assert 0.0 <= si.starvation() <= 1.0
+    assert si.degraded is None
+
+
+def test_ring_smaller_than_chunks_still_exact(stream_file):
+    """Workers block on full rings (bounded look-ahead) without changing
+    the stream."""
+    path, dim, _ = stream_file
+    ref = _reference_rows(path, dim)
+    si = ShardedIngest(
+        path, dim, IngestConfig(shards=2, chunk_kb=4, ring=1, slot_rows=64)
+    )
+    got = _sharded_rows(si)
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+
+
+# --- failure: degrade to in-process, reason-coded ------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_worker_kill_midstream_bit_identical(stream_file, seed):
+    """Seeded chaos: SIGKILL one parser after a seeded number of blocks.
+    The consumed row sequence must be EXACTLY the no-failure sequence and
+    the degrade must be reason-coded with the selfheal crash class."""
+    path, dim, _ = stream_file
+    ref = _reference_rows(path, dim)
+    rng = np.random.RandomState(seed)
+    kill_after = int(rng.randint(1, 12))
+    degrades = []
+    si = ShardedIngest(
+        path, dim, IngestConfig(shards=2, chunk_kb=8, wait_ms=2000),
+        on_degrade=degrades.append,
+    )
+    victim = si._procs[int(rng.randint(0, 2))]
+    xs, ys, ops = [], [], []
+    for i, (x, y, op) in enumerate(si.blocks()):
+        if i == kill_after and victim.is_alive():
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.join(timeout=5.0)
+        xs.append(x)
+        ys.append(y)
+        ops.append(op)
+    got = (np.concatenate(xs), np.concatenate(ys), np.concatenate(ops))
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+    assert si.degraded is not None
+    assert si.degraded["class"] == CRASH
+    assert degrades == [si.degraded]
+    assert si.degraded["chunk"] >= 0
+
+
+def test_wedged_worker_classified_hang(stream_file):
+    """A SIGSTOP'd parser (alive but silent past waitMs) degrades with
+    the hang class; the stream still completes bit-identically."""
+    path, dim, _ = stream_file
+    ref = _reference_rows(path, dim)
+    si = ShardedIngest(
+        path, dim, IngestConfig(shards=2, chunk_kb=16, wait_ms=250)
+    )
+    victim = si._procs[1]
+    os.kill(victim.pid, signal.SIGSTOP)
+    # un-wedge shortly after the degrade fires so close() can reap it
+    timer = threading.Timer(
+        1.0, lambda: os.kill(victim.pid, signal.SIGCONT)
+    )
+    timer.start()
+    try:
+        got = _sharded_rows(si)
+    finally:
+        timer.cancel()
+        try:
+            os.kill(victim.pid, signal.SIGCONT)
+        except (ProcessLookupError, OSError):
+            pass
+        si.close()
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)
+    assert si.degraded is not None
+    assert si.degraded["class"] == HANG
+
+
+# --- unarmed identity / job routing --------------------------------------
+
+
+def test_unarmed_job_routes_to_fused(monkeypatch):
+    from omldm_tpu.runtime import StreamJob
+
+    assert JobConfig().ingest == ""
+    job = StreamJob(JobConfig(parallelism=1))
+    assert job.ingest_cfg is None
+    calls = []
+    monkeypatch.setattr(
+        job, "run_file_fused", lambda *a, **k: calls.append("fused") or True
+    )
+    monkeypatch.setattr(
+        job, "run_file_sharded",
+        lambda *a, **k: calls.append("sharded") or True,
+    )
+    assert job.run_file("/nonexistent.jsonl", dim=4)
+    assert calls == ["fused"]
+
+
+def test_armed_job_routes_to_sharded(monkeypatch):
+    from omldm_tpu.runtime import StreamJob
+
+    job = StreamJob(JobConfig(parallelism=1, ingest="shards=1"))
+    assert job.ingest_cfg is not None and job.ingest_cfg.shards == 1
+    calls = []
+    monkeypatch.setattr(
+        job, "run_file_fused", lambda *a, **k: calls.append("fused") or True
+    )
+    monkeypatch.setattr(
+        job, "run_file_sharded",
+        lambda *a, **k: calls.append("sharded") or True,
+    )
+    assert job.run_file("/nonexistent.jsonl", dim=4)
+    assert calls == ["sharded"]
+
+
+def _pa_create(protocol="Synchronous"):
+    return json.dumps({
+        "id": 0,
+        "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+        "trainingConfiguration": {
+            "protocol": protocol, "syncEvery": 2,
+            "engine": "spmd", "stageChain": 2,
+        },
+    })
+
+
+def _run_job(path, dim, mode, ingest=""):
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    job = StreamJob(JobConfig(
+        parallelism=2, batch_size=64, test_set_size=64, ingest=ingest,
+    ))
+    job.process_event(REQUEST_STREAM, _pa_create())
+    job.ensure_deployed(dim)
+    if mode == "sharded":
+        assert job.run_file_sharded(path, dim=dim)
+    else:
+        for blk in iter_file_batches(path, dim, 4096):
+            job.process_packed_batch(*blk)
+    br = job.spmd_bridges[0]
+    if br._resident is not None:
+        br._resident.sync_host()
+    rep = job.terminate()
+    st = rep.statistics[0]
+    hx, hy = br.test_set.arrays()
+    return {
+        "params": br.trainer.global_flat_params().copy(),
+        "fitted": st.fitted, "score": st.score,
+        "hx": hx.copy(), "hy": hy.copy(),
+        "stats": job._ingest_stats,
+    }
+
+
+def test_streamjob_sharded_and_resident_bitwise_parity(stream_file):
+    """The core acceptance pin: packed, sharded, and sharded+device runs
+    of the SAME stream produce bitwise-equal trained params, fitted
+    counts, scores, and holdout contents."""
+    path, dim, n = stream_file
+    base = _run_job(path, dim, "packed")
+    assert base["fitted"] > 0
+    for ingest in ("shards=2,chunkKb=16", "shards=2,chunkKb=16,device=on"):
+        got = _run_job(path, dim, "sharded", ingest=ingest)
+        assert got["fitted"] == base["fitted"], ingest
+        assert got["score"] == base["score"], ingest
+        assert np.array_equal(got["params"], base["params"]), ingest
+        assert np.array_equal(got["hx"], base["hx"]), ingest
+        assert np.array_equal(got["hy"], base["hy"]), ingest
+        # phase attribution fodder survives the run
+        assert got["stats"]["rows"] == n
+        assert got["stats"]["parse_s"] >= 0.0
+
+
+# --- device-resident hot loop --------------------------------------------
+
+
+def _mk_bridge(preds, protocol="Synchronous", dim=6):
+    from omldm_tpu.api.requests import Request
+    from omldm_tpu.runtime.spmd_bridge import make_spmd_bridge
+
+    req = Request.from_json(_pa_create(protocol))
+    cfg = JobConfig(parallelism=2, batch_size=32, test_set_size=32)
+    return make_spmd_bridge(
+        req, dim, cfg, lambda p: preds.append(p.value), lambda r: None
+    )
+
+
+def test_resident_bridge_bit_identical_to_host():
+    rng = np.random.RandomState(0)
+    dim, n = 6, 1500
+    w = rng.randn(dim)
+    X = rng.randn(n, dim).astype(np.float32)
+    Y = (X @ w > 0).astype(np.float32)
+    results = {}
+    for mode in ("host", "resident"):
+        preds = []
+        br = _mk_bridge(preds)
+        if mode == "resident":
+            assert br.enable_resident_ingest()
+            assert not br.supports_fused_ingest()
+        i, sizes, s = 0, [1, 7, 150, 333, 64, 945], 0
+        while i < n:
+            m = min(sizes[s % len(sizes)], n - i)
+            s += 1
+            op = np.zeros(m, np.int64)
+            if m > 10:
+                op[m // 2] = 1  # forecast mid-block
+            br.handle_batch(X[i:i + m], Y[i:i + m], op)
+            i += m
+        snap = br.snapshot_buffers()
+        br.flush()
+        loss, score = br._evaluate()
+        if mode == "resident":
+            br._resident.sync_host()
+        hx, hy = br.test_set.arrays()
+        results[mode] = (
+            br.trainer.global_flat_params().copy(), br.trainer.fitted,
+            loss, score, hx.copy(), hy.copy(), list(preds),
+            snap["test_x"].copy(),
+        )
+    a, b = results["host"], results["resident"]
+    assert a[1] == b[1]  # fitted
+    assert (a[2], a[3]) == (b[2], b[3])  # loss, score
+    assert np.array_equal(a[0], b[0])  # params
+    assert np.array_equal(a[4], b[4]) and np.array_equal(a[5], b[5])
+    assert a[6] == b[6] and len(a[6]) > 0  # forecasts
+    assert np.array_equal(a[7], b[7])  # snapshot
+
+
+def test_resident_restore_roundtrip():
+    rng = np.random.RandomState(3)
+    dim = 6
+    X = rng.randn(700, dim).astype(np.float32)
+    Y = (X @ rng.randn(dim) > 0).astype(np.float32)
+    preds = []
+    src = _mk_bridge(preds)
+    assert src.enable_resident_ingest()
+    src.handle_batch(X, Y, np.zeros(len(X), np.int64))
+    snap = src.snapshot_buffers()
+    dst = _mk_bridge(preds)
+    assert dst.enable_resident_ingest()
+    dst.restore_buffers(snap)
+    dst._resident.sync_host()
+    src._resident.sync_host()
+    sx, sy = src.test_set.arrays()
+    dx, dy = dst.test_set.arrays()
+    assert np.array_equal(sx, dx) and np.array_equal(sy, dy)
+    assert len(dst.test_set) == len(src.test_set)
+
+
+def test_resident_arming_refusals():
+    # SSP pacing keeps per-row admission on the host: refuse
+    preds = []
+    br = _mk_bridge(preds, protocol="SSP")
+    assert not br.supports_resident_ingest()
+    assert not br.enable_resident_ingest()
+    # mid-stream arming (rows already buffered) is refused
+    br2 = _mk_bridge(preds)
+    br2.handle_batch(
+        np.ones((20, 6), np.float32), np.ones(20, np.float32),
+        np.zeros(20, np.int64),
+    )
+    assert not br2.enable_resident_ingest()
+    # a fresh bridge arms
+    br3 = _mk_bridge(preds)
+    assert br3.enable_resident_ingest()
+
+
+# --- backpressure probes --------------------------------------------------
+
+
+def test_prefetcher_as_signal_reports_emptiness():
+    from omldm_tpu.runtime.prefetch import Prefetcher
+
+    pf = Prefetcher(iter([1, 2, 3]), depth=2)
+    probe = pf.as_signal()
+    for item in pf:
+        pass
+    value, high, critical = probe()
+    assert (high, critical) == (0.75, 0.95)
+    assert value == 1.0  # drained ring = fully parse-bound
+    pf.close()
+
+
+def test_spoke_probe_attach_detach():
+    from omldm_tpu.runtime import StreamJob
+    from omldm_tpu.runtime.job import REQUEST_STREAM
+
+    # overload unarmed: no-op, no crash
+    job = StreamJob(JobConfig(parallelism=1))
+    job.process_event(REQUEST_STREAM, _pa_create())
+    for spoke in job.spokes:
+        spoke.attach_ingest_probe("x", lambda: (0.0, 1.0, 1.0))
+        spoke.detach_ingest_probe("x")
+    # overload armed (host-plane net: the controller arms per-net at
+    # deploy): the probe lands in extra_signals and detaches
+    job2 = StreamJob(JobConfig(
+        parallelism=1,
+        overload="window=8,share=2,hotHigh=6,hotCritical=12,cool=8",
+    ))
+    job2.process_event(REQUEST_STREAM, json.dumps({
+        "id": 0,
+        "request": "Create",
+        "learner": {"name": "PA", "hyperParameters": {"C": 1.0}},
+        "trainingConfiguration": {"protocol": "CentralizedTraining"},
+    }))
+    job2.ensure_deployed(6)
+    probe = lambda: (0.0, 0.5, 0.9)
+    armed = 0
+    for spoke in job2.spokes:
+        spoke.attach_ingest_probe("ingest_starvation", probe)
+        if spoke.overload is not None:
+            armed += 1
+            assert spoke.overload.extra_signals["ingest_starvation"] is probe
+        spoke.detach_ingest_probe("ingest_starvation")
+        if spoke.overload is not None:
+            assert "ingest_starvation" not in spoke.overload.extra_signals
+    assert armed > 0

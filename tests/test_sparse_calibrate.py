@@ -111,6 +111,35 @@ class TestCalibrate:
             assert e["winner"] in SCATTER_IMPLS
             assert set(e["rates_updates_per_sec"]) == set(SCATTER_IMPLS)
 
+    def test_tpu_guess_retired(self, tmp_path, monkeypatch):
+        """The round-5 ``D >= 2^16 -> mxu`` TPU guess is retired: an
+        UNCALIBRATED backend (no table section) resolves to the plain
+        scatter at any D — the guessed crossover was never measured (the
+        committed table's "tpu_status" annotation records the unreachable
+        chip), and a number nobody measured must not steer the dispatch.
+        A real TPU table section, once calibrated, still wins."""
+        import jax
+
+        from omldm_tpu.ops import sparse as sp
+
+        monkeypatch.delenv("OMLDM_SPARSE_SCATTER", raising=False)
+        monkeypatch.setenv(cal.ENV_TABLE, str(tmp_path / "absent.json"))
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert sp._resolve_impl(1 << 20, 1 << 10) == "scatter"
+        assert sp._resolve_impl(1 << 10, 1 << 10) == "scatter"
+        # a measured tpu section reinstates mxu where it actually won
+        path = tmp_path / "table.json"
+        path.write_text(json.dumps(_table({
+            "tpu": {"entries": [_entry(1 << 20, 1 << 10, "mxu")]},
+        })))
+        monkeypatch.setenv(cal.ENV_TABLE, str(path))
+        assert sp._resolve_impl(1 << 20, 1 << 10) == "mxu"
+        # the committed table records the honest no-chip annotation
+        committed = cal.load_table(cal.DEFAULT_TABLE)
+        status = committed.get("tpu_status")
+        assert status and status["calibrated"] is False
+        assert "tpu" not in committed["backends"]
+
 
 class TestLearnerWiring:
     def test_sparse_pa_update_honors_scatter_override(self, monkeypatch):
