@@ -1,0 +1,428 @@
+"""Fleet-scale load harness: seeded storms through the full plane matrix,
+gated on SLO budgets.
+
+Two legs, together covering the complete composition matrix (cohort x
+codec x guard x serving x overload x lifecycle x telemetry x events x
+selfheal x sharded ingest) — the first tooling that runs every plane at
+once under duress (ROADMAP open item 5):
+
+- :func:`run_inprocess_storm` — the in-process StreamJob engine with the
+  host planes armed (cohort, codec, guard, serving, overload, lifecycle,
+  telemetry, flight recorder, chaos), storm events interleaved at exact
+  record positions;
+- :func:`run_supervised_storm` — the supervised autoscaling fleet
+  (distributed engine subprocesses) with composed fault storms (crash /
+  hang / launch-refusal via the selfheal drivers), checkpoint/restore,
+  the count-clocked ``--requestSchedule`` churn, flight-recorder
+  incident bundles, and exactly-once output files.
+
+Both evaluate the same way: the storm's exact per-tenant accounting
+(runtime/loadgen) against the artifacts the run produced, through the
+SLO gates (runtime/slo). Replays of the same seed produce byte-identical
+deterministic report cores.
+
+CLI::
+
+    python -m benchmarks.load_harness --tenants 10000 --records 3000 \
+        --seed 7 --processes 2 --out /tmp/storm
+
+No reference counterpart: the reference ships no test or load tooling
+at all (PAPER.md §0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from omldm_tpu.runtime.loadgen import FaultSpec, LoadStorm, StormSpec
+from omldm_tpu.runtime import slo as slomod
+from omldm_tpu.runtime.slo import SLOBudgets, SLOReport
+
+
+# trainingConfiguration extras arming the per-pipeline planes on every
+# storm Create/Update (serving + overload + codec + guard); the job-wide
+# planes (cohort, lifecycle, telemetry, events, ingest) arm via
+# JobConfig / worker flags
+FULL_MATRIX_TC = {
+    "serving": {"maxBatch": 32, "maxDelayMs": 50},
+    "overload": "window=64,share=4,hotHigh=192,hotCritical=512",
+    "comm": {"codec": "int8"},
+    "guard": True,
+}
+
+
+def default_storm_spec(
+    seed: int = 7,
+    tenants: int = 256,
+    records: int = 1024,
+    chunk_rows: int = 64,
+    *,
+    faults: Sequence[FaultSpec] = (),
+    training_extra: Optional[dict] = None,
+    churn: bool = True,
+    protocol: str = "CentralizedTraining",
+) -> StormSpec:
+    """The canonical composed storm: churn waves + diurnal curve +
+    hot-tenant bursts + mixed traffic, scaled by tenant/record count."""
+    return StormSpec(
+        seed=seed,
+        tenants=tenants,
+        records=records,
+        chunk_rows=chunk_rows,
+        n_features=4,
+        forecast_ratio=0.3,
+        diurnal_amplitude=0.5,
+        diurnal_period=max(records // 4, 1),
+        hot_tenants=min(2, tenants),
+        burst_every=max(records // 8, 1),
+        burst_len=max(records // 64, 1),
+        addressed_fraction=0.1,
+        churn_waves=3 if churn else 0,
+        churn_tenants_per_wave=4 if churn else 0,
+        churn_updates_per_wave=1 if churn else 0,
+        protocol=protocol,
+        training_extra=dict(training_extra or {}),
+        faults=tuple(faults),
+    )
+
+
+# every plane CONFIGURED (objects constructed, code paths installed) in a
+# state that must not alter the data path: overload thresholds uniform
+# broadcast traffic can never trip, serving at immediate emission
+# (maxBatch=1 — armed batching defers forecasts past training records,
+# which legitimately changes values), lifecycle/telemetry/events
+# observe-only. The composition-identity leg pins a bare run ==
+# bit-identical to all of this at once.
+UNARMED_MATRIX_KW = dict(
+    cohort="auto",
+    cohort_min=8,
+    overload="window=64,share=4,hotHigh=192,hotCritical=512",
+    serving="maxBatch=1,maxDelayMs=0",
+    lifecycle="on",
+    telemetry="statsEvery=256",
+    events="cap=256,watchdogEvery=256",
+)
+
+
+def prediction_digest(job) -> Dict[int, list]:
+    """Bit-identity evidence: per-tenant ordered (features, value)
+    pairs over the complete output stream."""
+    out: Dict[int, list] = {}
+    for p in job.predictions:
+        feats = tuple(p.data_instance.numerical_features)
+        out.setdefault(p.mlp_id, []).append((feats, p.value))
+    return out
+
+
+def run_composition_identity(storm: LoadStorm) -> Tuple[dict, dict]:
+    """The full-composition identity leg: the storm through a bare
+    StreamJob and through every plane configured-but-unarmed
+    (UNARMED_MATRIX_KW). Returns both prediction digests — equal iff
+    the unarmed matrix is bit-transparent."""
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime.job import StreamJob
+
+    digests = []
+    for kw in ({}, UNARMED_MATRIX_KW):
+        job = StreamJob(JobConfig(batch_size=16, test_set_size=16, **kw))
+        for line in storm.request_lines():
+            job.process_event("requests", line)
+        for stream, line in storm.events():
+            job.process_event(stream, line)
+        job.terminate()
+        digests.append(prediction_digest(job))
+    return digests[0], digests[1]
+
+
+# --- in-process leg ------------------------------------------------------
+
+
+def run_inprocess_storm(
+    storm: LoadStorm,
+    budgets: Optional[SLOBudgets] = None,
+    *,
+    armed: bool = True,
+    blackbox_dir: Optional[str] = None,
+) -> Tuple[SLOReport, "object"]:
+    """Drive the storm through the in-process StreamJob with the host
+    planes armed (or, ``armed=False``, every plane configured but
+    unarmed — the full-composition identity leg). Returns (slo_report,
+    job) — callers needing raw artifacts read the job."""
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime.job import StreamJob
+
+    spec = storm.spec
+    kw: Dict[str, object] = dict(
+        batch_size=32,
+        test_set_size=16,
+        cohort="auto",
+        cohort_min=8,
+    )
+    if armed:
+        kw.update(
+            overload="window=64,share=4,hotHigh=192,hotCritical=512",
+            serving="maxBatch=32,maxDelayMs=50",
+            lifecycle="on",
+            telemetry="statsEvery=256",
+            events="cap=256,watchdogEvery=256",
+        )
+        if blackbox_dir:
+            kw["blackbox_path"] = blackbox_dir
+    job = StreamJob(JobConfig(**kw))
+    # the initial Create wave precedes the stream; churn arrives
+    # interleaved at exact record positions via storm.events()
+    for line in storm.request_lines():
+        job.process_event("requests", line)
+    for stream, line in storm.events():
+        job.process_event(stream, line)
+    job_report = job.terminate()
+    actual: Dict[int, int] = {}
+    for p in job.predictions:
+        actual[p.mlp_id] = actual.get(p.mlp_id, 0) + 1
+    budgets = budgets or SLOBudgets()
+    report_dict = None
+    if job_report is not None:
+        report_dict = {
+            "statistics": [s.to_dict() for s in job_report.statistics]
+        }
+    expected = storm.expected_forecasts(
+        routed=armed, update_discards=False
+    )
+    stranded = None
+    if job.terminate_accounting is not None:
+        stranded = sum(
+            int(job.terminate_accounting.get(k, 0))
+            for k in (
+                "serving", "batcher", "throttled", "paused",
+                "pre_create", "backlog",
+            )
+        )
+    shed: Dict[int, int] = {}
+    if job_report is not None:
+        for s in job_report.statistics:
+            if s.forecasts_shed:
+                shed[s.pipeline] = s.forecasts_shed
+    slo_report = slomod.evaluate(
+        budgets,
+        expected=expected,
+        actual=actual,
+        healthy=storm.healthy_tenants(),
+        report=report_dict,
+        stranded_rows=stranded,
+        shed_by_tenant=shed,
+        fingerprint=storm.fingerprint(),
+        seed=spec.seed,
+        scenario={"leg": "inprocess", "armed": armed,
+                  "tenants": spec.tenants, "records": spec.records},
+    )
+    return slo_report, job
+
+
+# --- supervised fleet leg ------------------------------------------------
+
+
+def run_supervised_storm(
+    storm: LoadStorm,
+    out_dir: str,
+    budgets: Optional[SLOBudgets] = None,
+    *,
+    processes: int = 1,
+    restart_attempts: int = 3,
+    checkpoint_every: int = 2,
+    batch_size: int = 32,
+    test_set_size: int = 16,
+    timeout_s: int = 600,
+    extra_flags: Sequence[str] = (),
+    env_extra: Optional[Dict[str, str]] = None,
+) -> Tuple[SLOReport, Optional[dict], str]:
+    """Drive the storm through the supervised fleet: worker subprocesses
+    with the fault storm armed, checkpoint/restore, the count-clocked
+    churn schedule, flight-recorder bundles. Returns (slo_report,
+    merged_job_report, stderr)."""
+    os.makedirs(out_dir, exist_ok=True)
+    blackbox = os.path.join(out_dir, "blackbox")
+    preds = os.path.join(out_dir, "preds.jsonl")
+    perf = os.path.join(out_dir, "perf.jsonl")
+    args = storm.worker_args(
+        out_dir, checkpoint_every=checkpoint_every,
+    )
+    args += [
+        "--supervise", "true",
+        "--processes", str(processes),
+        "--restartAttempts", str(restart_attempts),
+        "--restartDelayMs", "50",
+        "--batchSize", str(batch_size),
+        "--testSetSize", str(test_set_size),
+        "--predictionsOut", preds,
+        "--performanceOut", perf,
+        "--flightRecorder", "on",
+        "--blackboxPath", blackbox,
+        # arm heartbeats so the supervisor can stamp a HEAL event on the
+        # relaunched fleet's first beat (the heal-after-fault endpoint).
+        # We want the beat files, not the reaper: workers beat mid-deploy
+        # every 256 pipelines, but one CHUNK of fan-out records through a
+        # 10k-pipeline fleet on a starved host can legitimately outlast a
+        # fixed window, so the timeout scales with fleet size
+        "--heartbeatTimeoutMs",
+        str(max(120_000, storm.spec.tenants * 100)),
+        # distributed-engine plane arming: overload backpressure +
+        # codec-through-trainingConfiguration ride the request lines;
+        # events/selfheal/checkpointing arm here
+        "--overload", "window=64,share=4,hotHigh=192,hotCritical=512",
+    ]
+    args += list(extra_flags)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    out = subprocess.run(
+        [sys.executable, "-m", "omldm_tpu.runtime.distributed_job"] + args,
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout_s,
+    )
+    stderr = out.stderr
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"supervised storm run failed rc={out.returncode}:\n"
+            f"{out.stdout[-2000:]}\n{stderr[-4000:]}"
+        )
+    report: Optional[dict] = None
+    if os.path.exists(perf):
+        lines = [l for l in open(perf).read().splitlines() if l.strip()]
+        if lines:
+            report = json.loads(lines[-1])
+    # prediction outputs: bare path at nproc==1, .pN suffixed otherwise
+    pred_paths = (
+        [preds] if os.path.exists(preds)
+        else sorted(glob.glob(preds + ".p*"))
+    )
+    actual = slomod.count_prediction_files(pred_paths)
+    # flight-recorder timeline: the last incident bundle carries the
+    # merged fleet history (supervisor decisions + worker rings)
+    events: List[dict] = []
+    bundles = sorted(
+        glob.glob(os.path.join(blackbox, "incident-*.json")),
+        key=lambda p: int(
+            os.path.basename(p).split("-")[1].split(".")[0]
+        ),
+    )
+    if bundles:
+        events = slomod.load_bundle_events(bundles[-1])
+    budgets = budgets or SLOBudgets()
+    slo_report = slomod.evaluate(
+        budgets,
+        expected=storm.expected_forecasts(routed=False),
+        actual=actual,
+        healthy=storm.healthy_tenants(),
+        report=report,
+        events=events,
+        fingerprint=storm.fingerprint(),
+        seed=storm.spec.seed,
+        scenario={
+            "leg": "supervised",
+            "tenants": storm.spec.tenants,
+            "records": storm.spec.records,
+            "processes": processes,
+            "faults": [f.kind for f in storm.spec.faults],
+        },
+    )
+    return slo_report, report, stderr
+
+
+# --- CLI -----------------------------------------------------------------
+
+
+def build_composed_storm(
+    seed: int, tenants: int, records: int, chunk_rows: int,
+    processes: int,
+) -> LoadStorm:
+    """The acceptance storm: churn + diurnal + bursts + two fault
+    classes (launch refusal then a mid-stream crash), sized so the crash
+    lands past the first checkpoint."""
+    faults = [
+        FaultSpec(kind="launch", process=max(processes - 1, 0), count=1),
+        FaultSpec(kind="crash", process=0, at_records=records // 2),
+    ]
+    spec = default_storm_spec(
+        seed=seed, tenants=tenants, records=records,
+        chunk_rows=chunk_rows, faults=faults,
+        # the SPMD engine hosts the collective protocols only;
+        # CentralizedTraining is the host-multiplexed (in-process) leg's
+        protocol="Synchronous",
+        training_extra={"syncEvery": 1, "comm": {"codec": "int8"}},
+    )
+    return LoadStorm(spec)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--tenants", type=int, default=256)
+    ap.add_argument("--records", type=int, default=1024)
+    ap.add_argument("--chunk-rows", type=int, default=64)
+    ap.add_argument("--processes", type=int, default=1)
+    ap.add_argument("--out", default="/tmp/omldm-storm")
+    ap.add_argument(
+        "--heal-budget-s", type=float, default=120.0,
+        help="heal-after-fault wall budget (measured gate)",
+    )
+    ap.add_argument(
+        "--p99-budget-ms", type=float, default=0.0,
+        help="serve p99 budget, 0 disables (measured gate)",
+    )
+    ap.add_argument(
+        "--replay", action="store_true",
+        help="run the storm twice and assert identical report cores",
+    )
+    ap.add_argument("--json", action="store_true", help="one-line JSON")
+    args = ap.parse_args(argv)
+
+    storm = build_composed_storm(
+        args.seed, args.tenants, args.records, args.chunk_rows,
+        args.processes,
+    )
+    budgets = SLOBudgets(
+        serve_p99_ms=args.p99_budget_ms or None,
+        heal_after_fault_s=args.heal_budget_s,
+        expected_heals=2,  # launch refusal + crash, both restarted
+        allow_shed_tenants=storm.hot_tenant_ids(),
+        max_stranded_rows=0,
+    )
+    slo_report, _, _ = run_supervised_storm(
+        storm, os.path.join(args.out, "run1"), budgets,
+        processes=args.processes,
+    )
+    result = slo_report.to_dict()
+    if args.replay:
+        replay_storm = build_composed_storm(
+            args.seed, args.tenants, args.records, args.chunk_rows,
+            args.processes,
+        )
+        slo2, _, _ = run_supervised_storm(
+            replay_storm, os.path.join(args.out, "run2"), budgets,
+            processes=args.processes,
+        )
+        result["replayIdentical"] = (
+            slo_report.core_digest() == slo2.core_digest()
+        )
+        if not result["replayIdentical"]:
+            result["passed"] = False
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(json.dumps(result, indent=2))
+    return 0 if result["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

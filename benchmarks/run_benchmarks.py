@@ -1202,11 +1202,104 @@ def bench_prediction_latency():
     return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
 
 
+def _next_slo_round() -> int:
+    """The next SLO trajectory index: SLO_r01.json, SLO_r02.json, ...
+    alongside the RESULTS_rXX.json rounds in benchmarks/."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(here, "SLO_r*.json"))
+        for m in [re.match(r"SLO_r(\d+)\.json$", os.path.basename(p))]
+        if m
+    ]
+    return max(rounds, default=0) + 1
+
+
+def emit_slo_round(tenants: int, records: int, out_path: str = "") -> str:
+    """One SLO trajectory round (ISSUE 19): the seeded composed storm
+    (churn waves + diurnal curve + hot-tenant bursts + two fault
+    classes) through the supervised fleet, evaluated against the SLO
+    budgets, run TWICE — the round records the verdict sheet plus
+    whether the same-seed replay reproduced a byte-identical
+    deterministic core. Writes SLO_rXX.json next to the RESULTS rounds
+    and returns the path."""
+    import tempfile
+
+    from benchmarks.load_harness import (
+        build_composed_storm,
+        run_supervised_storm,
+    )
+    from omldm_tpu.runtime.slo import SLOBudgets
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = out_path or os.path.join(
+        here, f"SLO_r{_next_slo_round():02d}.json"
+    )
+    t0 = time.time()
+    reports = []
+    tmp = tempfile.mkdtemp(prefix="omldm-slo-round-")
+    for run in ("run1", "run2"):
+        storm = build_composed_storm(
+            7, tenants=tenants, records=records, chunk_rows=64,
+            processes=1,
+        )
+        budgets = SLOBudgets(
+            # generous heal wall budget: a relaunch restores every
+            # tenant pipeline from the snapshot before its first beat
+            heal_after_fault_s=600.0,
+            expected_heals=2,
+            allow_shed_tenants=storm.hot_tenant_ids(),
+            max_stranded_rows=0,
+        )
+        rep, _, _ = run_supervised_storm(
+            storm, os.path.join(tmp, run), budgets, processes=1,
+            timeout_s=3000,
+        )
+        reports.append(rep)
+    result = reports[0].to_dict()
+    result["replayIdentical"] = (
+        reports[0].core_digest() == reports[1].core_digest()
+    )
+    if not result["replayIdentical"]:
+        result["passed"] = False
+    result["wallS"] = round(time.time() - t0, 1)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "config": "slo_round",
+        "out": os.path.basename(out_path),
+        "passed": result["passed"],
+        "replay_identical": result["replayIdentical"],
+        "wall_s": result["wallS"],
+    }))
+    return out_path
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--e2e-records", type=int, default=300_000)
+    ap.add_argument(
+        "--slo-only", action="store_true",
+        help="record one SLO trajectory round (SLO_rXX.json) and exit",
+    )
+    ap.add_argument(
+        "--slo-tenants", type=int, default=10_000,
+        help="tenant count for the SLO round's composed storm",
+    )
+    ap.add_argument(
+        "--slo-records", type=int, default=256,
+        help="record count for the SLO round's composed storm",
+    )
     args = ap.parse_args()
+
+    if args.slo_only:
+        emit_slo_round(args.slo_tenants, args.slo_records)
+        return
 
     # persistent XLA compile cache: the suite's first-compile cost (tens of
     # seconds per program on TPU) drops out of repeat runs
@@ -1338,6 +1431,14 @@ def main():
             }
         )
     )
+    # every BENCH round also records an SLO trajectory point: the
+    # supervised fleet under the composed fault storm, gated and
+    # replay-checked (the storm runs on the CPU worker fleet, so a
+    # failure here never reflects chip state)
+    try:
+        emit_slo_round(args.slo_tenants, args.slo_records)
+    except Exception as exc:
+        print(f"slo round failed: {exc}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -48,7 +48,7 @@ Protocol mapping (SURVEY.md section 7 step 5):
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -77,6 +77,24 @@ SPMD_PROTOCOLS = (
     "Asynchronous",
     "SSP",
 )
+
+
+# Compiled programs shared across same-config trainers. A fleet hosts
+# tens of thousands of pipelines whose step/serve/scan programs are
+# IDENTICAL up to the state flowing through them; one jax.jit closure
+# per trainer would compile (and keep the JIT code pages of) one
+# executable each, which exhausts the process mmap budget
+# (vm.max_map_count, 65530 by default) around ~10k pipelines. The cache
+# key is the trainer's full static signature, and every cached callable
+# takes the state explicitly, so sharing is semantics-free.
+_PROGRAM_CACHE: Dict[tuple, Any] = {}
+
+
+def _program(key: tuple, build):
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = _PROGRAM_CACHE[key] = build()
+    return fn
 
 
 def _sq(leaf):
@@ -177,19 +195,37 @@ class SPMDTrainer:
             lambda _: P("dp", "hub"), state_host
         )
 
+        # the static signature every compiled program of this trainer is
+        # a pure function of: trainers agreeing on it share executables
+        # through _PROGRAM_CACHE (their step closures are interchangeable
+        # — self._flat / self._ps_allreduce depend only on these fields)
+        self.program_key = (
+            id(self.mesh),
+            repr(learner_spec),
+            tuple(repr(p) for p in preprocessor_specs),
+            dim, protocol, batch_size, self.sync_every, self.threshold,
+            self.staleness, self.alpha, self.codec_name,
+            bool(self.tc.per_record),
+        )
         step_impl = self._build_step()
         self._step_fn = step_impl
         self._step_many = None  # built lazily on first step_many call
         self._step_many_dense = None  # lazily too (mask-free bulk variant)
         batch_spec = P("dp")
-        self._step = jax.jit(
-            shard_map(
-                step_impl,
-                mesh=self.mesh,
-                in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
-                out_specs=(self._state_specs, P("dp", "hub")),
+        self._step = _program(
+            ("step",) + self.program_key,
+            lambda: jax.jit(
+                shard_map(
+                    step_impl,
+                    mesh=self.mesh,
+                    in_specs=(
+                        self._state_specs, batch_spec, batch_spec,
+                        batch_spec,
+                    ),
+                    out_specs=(self._state_specs, P("dp", "hub")),
+                ),
+                donate_argnums=0,
             ),
-            donate_argnums=0,
         )
         self._fitted_host = 0
         self._steps_host = 0
@@ -559,14 +595,20 @@ class SPMDTrainer:
 
                 return jax.lax.scan(body, state, (xs, ys, masks))
 
-            self._step_many = jax.jit(
-                shard_map(
-                    many_impl,
-                    mesh=self.mesh,
-                    in_specs=(self._state_specs, batch_spec, batch_spec, batch_spec),
-                    out_specs=(self._state_specs, P(None, "dp", "hub")),
+            self._step_many = _program(
+                ("step_many",) + self.program_key,
+                lambda: jax.jit(
+                    shard_map(
+                        many_impl,
+                        mesh=self.mesh,
+                        in_specs=(
+                            self._state_specs, batch_spec, batch_spec,
+                            batch_spec,
+                        ),
+                        out_specs=(self._state_specs, P(None, "dp", "hub")),
+                    ),
+                    donate_argnums=0,
                 ),
-                donate_argnums=0,
             )
         counts = batch_valid_counts(masks, valid_counts)
         self.state, losses = self._step_many(self.state, xs, ys, masks)
@@ -597,14 +639,17 @@ class SPMDTrainer:
 
                 return jax.lax.scan(body, state, (xs, ys))
 
-            self._step_many_dense = jax.jit(
-                shard_map(
-                    many_dense_impl,
-                    mesh=self.mesh,
-                    in_specs=(self._state_specs, batch_spec, batch_spec),
-                    out_specs=(self._state_specs, P(None, "dp", "hub")),
+            self._step_many_dense = _program(
+                ("step_many_dense",) + self.program_key,
+                lambda: jax.jit(
+                    shard_map(
+                        many_dense_impl,
+                        mesh=self.mesh,
+                        in_specs=(self._state_specs, batch_spec, batch_spec),
+                        out_specs=(self._state_specs, P(None, "dp", "hub")),
+                    ),
+                    donate_argnums=0,
                 ),
-                donate_argnums=0,
             )
         t, dp, b = xs.shape[0], xs.shape[1], xs.shape[2]
         self.state, losses = self._step_many_dense(self.state, xs, ys)
@@ -639,9 +684,12 @@ class SPMDTrainer:
         can never advance on zero-mask batches, which would pin the bound
         and livelock peers' drains — possible in the multi-process
         deployment where rows cannot be re-striped across processes)."""
-        new_clock = jax.jit(
-            lambda c: jnp.full_like(c, c.max()),
-            out_shardings=NamedSharding(self.mesh, P("dp", "hub")),
+        new_clock = _program(
+            ("release_clock", id(self.mesh)),
+            lambda: jax.jit(
+                lambda c: jnp.full_like(c, c.max()),
+                out_shardings=NamedSharding(self.mesh, P("dp", "hub")),
+            ),
         )(self.state["clock"])
         self.state = {**self.state, "clock": new_clock}
 
@@ -822,7 +870,10 @@ class SPMDTrainer:
                     self.learner.score(params, z, y, mask),
                 )
 
-            self._serve_cache = (jax.jit(predict_fn), jax.jit(eval_fn))
+            self._serve_cache = _program(
+                ("serve",) + self.program_key,
+                lambda: (jax.jit(predict_fn), jax.jit(eval_fn)),
+            )
         return self._serve_cache
 
     @staticmethod

@@ -57,7 +57,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -322,6 +322,16 @@ class DistributedStreamJob:
         self.responses: List[QueryResponse] = []
         self.response_merger = ResponseMerger(self.responses.append)
         self.orphan_predictions: List[Tuple[int, float]] = []
+        # liveness callback invoked mid-deploy: a fleet-scale Create
+        # wave (or a restore redeploying it) constructs pipelines for
+        # far longer than a heartbeat window, and a worker that is
+        # provably alive must not read as beat-silent
+        self.beat_hook: Optional[Callable[[], None]] = None
+        # per-pipeline collective programs shared across pipelines whose
+        # trainers agree on the full static signature — one compiled
+        # executable per CONFIG, not per pipeline (the fleet-scale mmap
+        # budget; parallel.spmd shares the step programs the same way)
+        self._prog_cache: Dict[tuple, Any] = {}
         self.start_time = time.time()
         # overload control (runtime/overload.py; --overload / JobConfig):
         # on the distributed engine the honest backlog signal is the
@@ -578,27 +588,74 @@ class DistributedStreamJob:
 
     # --- control plane: process-0 broadcast over the fabric ---
 
+    # frame header: 4-byte payload length + 1-byte continuation flag
+    _FRAME_HEADER = 5
+
+    def _frame_batches(self, lines: List[str]) -> List[List[str]]:
+        """Greedy-pack request lines into frames that fit the fixed
+        broadcast buffer (a fleet-scale Create wave — tens of thousands
+        of tenants — is far larger than one frame)."""
+        cap = CONTROL_CAP - self._FRAME_HEADER
+        batches: List[List[str]] = [[]]
+        size = 0
+        for line in lines:
+            n = len(line.encode("utf-8"))
+            if n > cap:
+                raise ValueError(
+                    f"request line too large for the control broadcast "
+                    f"({n} bytes > {cap})"
+                )
+            if batches[-1] and size + 1 + n > cap:
+                batches.append([])
+                size = 0
+            size += n + (1 if len(batches[-1]) else 0)
+            batches[-1].append(line)
+        return batches
+
     def _broadcast_lines(self, lines: List[str]) -> List[str]:
-        """Every process receives process 0's request lines. The payload
+        """Every process receives process 0's request lines. Each frame
         travels as a [nproc, CONTROL_CAP] uint8 array assembled from
         per-process rows; a replicated-output jit hands every process row
-        0 — i.e. the broadcast IS a collective on the training fabric."""
+        0 — i.e. the broadcast IS a collective on the training fabric.
+        Payloads larger than one frame stream as multiple frames, paced
+        by a continuation flag in the header: every process loops until
+        process 0's flag clears, so the collective count stays lockstep
+        without anybody knowing the total up front."""
+        out: List[str] = []
+        batches = self._frame_batches(lines) if self.pid == 0 else [[]]
+        i = 0
+        while True:
+            batch = batches[i] if i < len(batches) else []
+            more = self.pid == 0 and i + 1 < len(batches)
+            received, more = self._broadcast_frame(batch, more)
+            out.extend(received)
+            i += 1
+            if not more:
+                return out
+
+    def _broadcast_frame(
+        self, lines: List[str], more: bool
+    ) -> Tuple[List[str], bool]:
+        """One fixed-size broadcast collective; returns (lines, more) as
+        decoded from process 0's row."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from omldm_tpu.parallel.multihost import host_local_array
 
         payload = "\n".join(lines).encode("utf-8") if self.pid == 0 else b""
-        if len(payload) > CONTROL_CAP - 4:
+        hdr = self._FRAME_HEADER
+        if len(payload) > CONTROL_CAP - hdr:
             raise ValueError(
                 f"control broadcast overflow ({len(payload)} bytes > "
-                f"{CONTROL_CAP - 4}); split the request batch"
+                f"{CONTROL_CAP - hdr}); split the request batch"
             )
         row = np.zeros((1, CONTROL_CAP), np.uint8)
         row[0, :4] = np.frombuffer(
             np.uint32(len(payload)).tobytes(), np.uint8
         )
-        row[0, 4 : 4 + len(payload)] = np.frombuffer(payload, np.uint8)
+        row[0, 4] = 1 if more else 0
+        row[0, hdr : hdr + len(payload)] = np.frombuffer(payload, np.uint8)
         if self.nproc == 1:
             rows = row
         else:
@@ -612,8 +669,8 @@ class DistributedStreamJob:
             )
             rows = self._fetch_replicated(take0(arr))[None, :]
         n = int(np.frombuffer(rows[0, :4].tobytes(), np.uint32)[0])
-        text = rows[0, 4 : 4 + n].tobytes().decode("utf-8")
-        return [l for l in text.split("\n") if l]
+        text = rows[0, hdr : hdr + n].tobytes().decode("utf-8")
+        return [l for l in text.split("\n") if l], bool(rows[0, 4])
 
     def sync_requests(self, lines: Optional[List[str]] = None) -> None:
         """Process 0 passes its pending request lines; every process runs
@@ -626,10 +683,22 @@ class DistributedStreamJob:
         with self.hang_guard("control"):
             self._sync_requests_guarded(lines)
 
+    def _deploy_beat(self, i: int) -> None:
+        if self.beat_hook is not None and i % 256 == 255:
+            self.beat_hook()
+
+    def _shared_jit(self, p: "_DistPipeline", name: str, build):
+        key = (name, p.sparse, p.dim, p.trainer.program_key)
+        fn = self._prog_cache.get(key)
+        if fn is None:
+            fn = self._prog_cache[key] = build()
+        return fn
+
     def _sync_requests_guarded(
         self, lines: Optional[List[str]] = None
     ) -> None:
-        for line in self._broadcast_lines(list(lines or [])):
+        for i, line in enumerate(self._broadcast_lines(list(lines or []))):
+            self._deploy_beat(i)
             request = Request.from_json(line)
             if request is None:
                 self._warn(f"dropping unparseable request line: {line[:120]!r}")
@@ -1012,8 +1081,11 @@ class DistributedStreamJob:
 
         if p._accepted_jit is None:
             rep = NamedSharding(self.mesh, P())
-            p._accepted_jit = jax.jit(
-                lambda s: s["accepted"][:, 0] > 0.0, out_shardings=rep
+            p._accepted_jit = self._shared_jit(
+                p, "accepted",
+                lambda: jax.jit(
+                    lambda s: s["accepted"][:, 0] > 0.0, out_shardings=rep
+                ),
             )
         acc = self._fetch_replicated(p._accepted_jit(p.trainer.state))
         lo = self.pid * self.dp_local
@@ -1071,7 +1143,10 @@ class DistributedStreamJob:
                         z = prep.transform(w0(s), z)
                     return t.learner.predict(w0(state["params"]), z)
 
-            p._predict_jit = jax.jit(predict_fn, out_shardings=rep)
+            p._predict_jit = self._shared_jit(
+                p, "predict",
+                lambda: jax.jit(predict_fn, out_shardings=rep),
+            )
         width = p.max_nnz if p.sparse else p.dim
         buf = (
             np.concatenate(p.fore_x)
@@ -1178,7 +1253,10 @@ class DistributedStreamJob:
                 flat, _ = jax.flatten_util.ravel_pytree(w0)
                 return flat
 
-            p._gather_params_jit = jax.jit(gather_fn, out_shardings=rep)
+            p._gather_params_jit = self._shared_jit(
+                p, "gather_params",
+                lambda: jax.jit(gather_fn, out_shardings=rep),
+            )
         flat = self._fetch_replicated(p._gather_params_jit(p.trainer.state))
         fitted = int(self._collective_reduce(
             [float(p.trainer.fitted)], "sum"
@@ -1303,7 +1381,10 @@ class DistributedStreamJob:
                         t.learner.score(params, z, yv, mv),
                     )
 
-            p._eval_jit = jax.jit(eval_fn, out_shardings=(rep, rep))
+            p._eval_jit = self._shared_jit(
+                p, "eval",
+                lambda: jax.jit(eval_fn, out_shardings=(rep, rep)),
+            )
         if p.sparse:
             loss, score = p._eval_jit(p.trainer.state, x_d, v_d, y_d, m_d)
         else:
@@ -1322,13 +1403,16 @@ class DistributedStreamJob:
 
         if p._counters_jit is None:
             rep = NamedSharding(self.mesh, P())
-            p._counters_jit = jax.jit(
-                lambda s: (
-                    s["syncs"][:, 0].sum(),
-                    s["syncs"][0, 0],
-                    s["step"][0, 0],
+            p._counters_jit = self._shared_jit(
+                p, "counters",
+                lambda: jax.jit(
+                    lambda s: (
+                        s["syncs"][:, 0].sum(),
+                        s["syncs"][0, 0],
+                        s["step"][0, 0],
+                    ),
+                    out_shardings=(rep, rep, rep),
                 ),
-                out_shardings=(rep, rep, rep),
             )
         a, b, c = p._counters_jit(p.trainer.state)
         return (
@@ -1401,6 +1485,13 @@ class DistributedStreamJob:
                 entries.append(stats)
                 holdout[str(net_id)] = hold
                 requeued_local += getattr(p.trainer, "requeued_rows", 0)
+        # terminate-time stranded-row accounting (collective: every
+        # process contributes its staging backlog) — the SLO evaluator's
+        # no-stranded-rows gate reads this instead of trusting the drive
+        # loop to have drained
+        stranded = self._collective_reduce(
+            [float(self.backlog_rows())], "sum"
+        )
         if self.pid != 0:
             return None
         report = JobStatistics(
@@ -1421,6 +1512,9 @@ class DistributedStreamJob:
         # LOCAL count (process 0's workers): >0 proves the SSP requeue
         # path executed in this run
         report["requeuedLocal"] = requeued_local
+        report["terminateAccounting"] = {
+            "backlogRows": int(stranded[0]),
+        }
         return report
 
     # --- checkpoint / restore (FlinkSpoke.scala:233-334 semantics) ---
@@ -1461,8 +1555,9 @@ class DistributedStreamJob:
             p = self.pipelines[net_id]
             if p._gather_state_jit is None:
                 specs = jax.tree_util.tree_map(lambda _: rep, p.trainer.state)
-                p._gather_state_jit = jax.jit(
-                    lambda s: s, out_shardings=specs
+                p._gather_state_jit = self._shared_jit(
+                    p, "gather_state",
+                    lambda: jax.jit(lambda s: s, out_shardings=specs),
                 )
             # the jitted gather is COLLECTIVE (every process dispatches
             # it), but only process 0 pays the host fetch + write — the
@@ -1822,7 +1917,8 @@ class DistributedStreamJob:
         # not exist yet in this incarnation.
         import dataclasses as _dc
 
-        for line in manifest["request_lines"]:
+        for i, line in enumerate(manifest["request_lines"]):
+            self._deploy_beat(i)
             request = Request.from_json(line)
             assert request is not None, "corrupt manifest request line"
             if request.request == RequestType.UPDATE:
@@ -2134,6 +2230,66 @@ def _sync_requests_from_flags(
     job.sync_requests(lines)
 
 
+def _load_request_schedule(
+    flags: Dict[str, str]
+) -> List[Tuple[int, str]]:
+    """The count-clocked mid-stream request schedule (--requestSchedule):
+    JSONL ``{"atRecord": N, "request": {...}}`` entries, sorted by
+    position. EVERY process reads the shared file and computes dueness
+    locally from the cursor (identical across processes), so the
+    collective sync fires only at pump points where something is due —
+    the deterministic, replayable stand-in for the Kafka requests topic's
+    wall-clock polling."""
+    path = flags.get("requestSchedule")
+    if not path:
+        return []
+    entries: List[Tuple[int, str]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            entries.append(
+                (int(obj["atRecord"]), json.dumps(obj["request"]))
+            )
+    entries.sort(key=lambda e: e[0])
+    return entries
+
+
+def _schedule_start(
+    schedule: List[Tuple[int, str]], resume_cursor: int
+) -> int:
+    """First schedule index NOT yet delivered at ``resume_cursor``:
+    entries at/before the checkpoint cut were applied pre-snapshot and
+    live in the restored manifest — redelivering them would double-churn
+    the topology."""
+    i = 0
+    while i < len(schedule) and schedule[i][0] <= resume_cursor:
+        i += 1
+    return i
+
+
+def _deliver_due_requests(
+    job: DistributedStreamJob,
+    schedule: List[Tuple[int, str]],
+    idx: int,
+    cursor: int,
+) -> int:
+    """Deliver every schedule entry with ``atRecord <= cursor`` (one
+    collective sync for the batch); returns the advanced index. Called at
+    the synchronized pump point BEFORE the checkpoint cadence, so a
+    snapshot at this cut already contains the new topology."""
+    if idx >= len(schedule) or schedule[idx][0] > cursor:
+        return idx
+    due: List[str] = []
+    while idx < len(schedule) and schedule[idx][0] <= cursor:
+        due.append(schedule[idx][1])
+        idx += 1
+    job.sync_requests(due if job.pid == 0 else [])
+    return idx
+
+
 def _restore_or_fresh(job: DistributedStreamJob, flags: Dict[str, str]):
     """Restore the latest consistent snapshot; when NO candidate is usable
     (all corrupt/withheld — restore_checkpoint already warned), degrade to
@@ -2323,6 +2479,8 @@ def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
             job._warn(f"restored; resuming at row {resume_cursor}")
     assert job.dim is not None, "no pipeline deployed and no snapshot found"
     injector = _make_injector(job, flags)
+    schedule = _load_request_schedule(flags)
+    sched_idx = _schedule_start(schedule, resume_cursor)
     cursor = 0
     chunk_idx = 0
     chunk_rows = int(flags.get("chunkRows", str(CHUNK_ROWS)))
@@ -2348,10 +2506,20 @@ def _drive_file(job: DistributedStreamJob, flags: Dict[str, str]) -> None:
         if fore.any():
             job.handle_forecast_rows(bx[fore])
         # synchronized pump point: every process sees the same chunk
-        # sequence
+        # sequence. Scheduled requests land BEFORE the checkpoint cadence
+        # so a snapshot at this cut carries the new topology (a restore
+        # never redelivers them — _schedule_start skips the applied
+        # prefix)
         job.pump()
+        sched_idx = _deliver_due_requests(job, schedule, sched_idx, cursor)
         _chunk_tick(job, flags, chunk_idx, cursor, injector, records=n)
         chunk_idx += 1
+    # entries scheduled past the end of the stream still belong to the
+    # storm: deliver them at the final cut instead of dropping silently
+    if sched_idx < len(schedule):
+        sched_idx = _deliver_due_requests(
+            job, schedule, sched_idx, schedule[-1][0]
+        )
     job.flush()
 
 
@@ -2808,6 +2976,12 @@ def run_distributed(argv: Optional[List[str]] = None) -> int:
             warmup_s=float(flags.get("collectiveWarmupMs", "120000"))
             / 1000.0,
         )
+
+    def _mid_deploy_beat() -> None:
+        if not _heartbeat(flags, job.pid, job.heartbeat_frame()):
+            job.hb_write_errors += 1
+
+    job.beat_hook = _mid_deploy_beat
     # process 0 reads the request file; everyone else receives the
     # broadcast (passing lines from a non-0 process is ignored). On a
     # restore the manifest redeploys the pipeline map instead — the

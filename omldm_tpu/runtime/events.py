@@ -56,6 +56,8 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from omldm_tpu.utils import clock as uclock
+
 # --- event taxonomy ---------------------------------------------------------
 # kinds are a closed vocabulary (the README table); causes are free-form
 # machine-readable strings scoped by kind
@@ -92,6 +94,7 @@ STRIKE = "strike"                    # classified failure charged to a slot
 DEGRADE = "degrade"                  # shrink-to-survivors decided
 PROBE = "probe"                      # re-expansion probe signaled/settled
 HANG = "hang"                        # worker hang-watchdog fired (HANG_EXIT)
+HEAL = "heal"                        # relaunched fleet's first heartbeat
 # recorder-internal
 ALERT = "alert"                      # watchdog rule fired
 ALERT_CLEAR = "alert_clear"          # watchdog rule cleared (hysteresis)
@@ -293,7 +296,7 @@ class EventJournal:
         cap: int = DEFAULT_CAP,
         pid: Any = 0,
         path: str = "",
-        clock: Callable[[], float] = time.time,
+        clock: Callable[[], float] = uclock.WALL,
         position: Optional[Callable[[], int]] = None,
         tail_len: int = DEFAULT_TAIL,
     ):
@@ -618,7 +621,7 @@ class Watchdog:
         cfg: EventsConfig,
         journal: EventJournal,
         on_alert: Optional[Callable[[dict], None]] = None,
-        clock: Callable[[], float] = time.time,
+        clock: Callable[[], float] = uclock.WALL,
     ):
         self.cfg = cfg
         self.journal = journal
@@ -788,7 +791,7 @@ class FlightRecorder:
         self,
         cfg: EventsConfig,
         pid: Any = 0,
-        clock: Callable[[], float] = time.time,
+        clock: Callable[[], float] = uclock.WALL,
         position: Optional[Callable[[], int]] = None,
         on_alert: Optional[Callable[[dict], None]] = None,
         blackbox_default: str = "",

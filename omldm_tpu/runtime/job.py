@@ -175,6 +175,10 @@ class StreamJob:
         # are ("inst", DataInstance) or ("__packed__", (x, y, op), None,
         # None) so packed blocks trim by row count.
         self._backlog = _PauseBuffer(PRE_CREATE_BACKLOG_CAP)
+        # queue_depths() snapshot taken at terminate, after the drain
+        # cascade (None until terminate runs) — the load harness' SLO
+        # evaluator asserts no stranded rows from it
+        self.terminate_accounting: Optional[dict] = None
         # stream position: events consumed so far. Checkpoints record it so
         # a supervisor can resume a replayable source from the exact event
         # the snapshot covers (the role of Flink's source offsets in a
@@ -1583,6 +1587,10 @@ class StreamJob:
                     )
                 )
                 self.stats.add_hub_statistics(net_id, merged)
+        # terminate-time stranded-row snapshot: after the probe/flush
+        # cascade above every queue must be empty — the SLO evaluator's
+        # no-stranded-rows gate reads this instead of trusting the drain
+        self.terminate_accounting = self.queue_depths()
         report = self.stats.try_finalize(
             len(self.pipeline_manager.live_pipelines)
         )

@@ -55,6 +55,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from omldm_tpu.utils.backoff import BackoffPolicy, seeded_rng
+from omldm_tpu.utils import clock as uclock
 
 # --- failure taxonomy -------------------------------------------------------
 
@@ -168,7 +169,7 @@ class SelfHealPolicy:
         min_processes: int = 1,
         probe_after_s: float = 30.0,
         probe_window_s: float = 10.0,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = uclock.MONOTONIC,
     ):
         if strike_threshold < 1:
             raise ValueError(
@@ -346,7 +347,7 @@ class HangWatchdog:
         on_expire: Callable[[str], None],
         *,
         warmup_s: Optional[float] = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = uclock.MONOTONIC,
         thread: bool = True,
         poll_s: Optional[float] = None,
     ):
@@ -453,7 +454,7 @@ def kill_escalate(
     term_deadline_s: float = 5.0,
     *,
     poll_s: float = 0.02,
-    clock: Callable[[], float] = time.monotonic,
+    clock: Callable[[], float] = uclock.MONOTONIC,
     sleep: Callable[[float], None] = time.sleep,
 ) -> List[int]:
     """Terminate a fleet: SIGTERM everyone, give the polite ones

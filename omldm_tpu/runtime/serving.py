@@ -47,6 +47,8 @@ import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from omldm_tpu.utils import clock as uclock
+
 import numpy as np
 
 from omldm_tpu.api.data import DataInstance, Prediction
@@ -254,7 +256,7 @@ class ServingPlane:
     def __init__(
         self,
         emit_prediction: Callable[[Prediction], None],
-        clock: Callable[[], float] = time.perf_counter,
+        clock: Callable[[], float] = uclock.PERF,
         emit_predictions: Optional[Callable[[List[Prediction]], None]] = None,
         timer=None,
     ):

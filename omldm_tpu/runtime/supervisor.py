@@ -61,6 +61,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional, Sequence
 
+from omldm_tpu.utils import clock as uclock
 from omldm_tpu.runtime.selfheal import (
     CRASH,
     HANG,
@@ -422,7 +423,15 @@ class DistributedJobSupervisor:
         restart_growth: float = 2.0,
         restart_seed: Optional[int] = None,
         kill_deadline_s: float = 5.0,
+        clock=None,
+        wall=None,
     ):
+        # injectable clocks (utils/clock.py): ``clock`` paces the
+        # monotonic policy windows (autoscale sustain, selfheal probes),
+        # ``wall`` stamps records that cross processes (beat-file ages,
+        # incident floors) — the load harness fast-forwards both
+        self._clock = uclock.resolve(clock, uclock.MONOTONIC)
+        self._wall = uclock.resolve(wall, uclock.WALL)
         if num_processes < 1:
             raise ValueError(f"num_processes must be >= 1, got {num_processes}")
         self.worker_args = list(worker_args)
@@ -468,10 +477,13 @@ class DistributedJobSupervisor:
         self.blackbox_dir = blackbox_dir
         self.journal = None
         self.bundles: List[str] = []
+        # set after a fleet failure; the relaunched fleet's first
+        # heartbeat records a HEAL event closing the restart window
+        self._heal_pending = False
         # dumps older than this run never enter a bundle (the
         # _ckpt_floor rule of the in-process supervisor, applied to a
         # reused black-box directory)
-        self._blackbox_floor = time.time()
+        self._blackbox_floor = self._wall()
         if blackbox_dir:
             from omldm_tpu.runtime.events import EventJournal
 
@@ -751,7 +763,7 @@ class DistributedJobSupervisor:
         pending_target = 0  # a written-but-not-yet-honored rescale signal
         decision_level = 0
         port = _free_port()
-        spawned_at = time.time()
+        spawned_at = self._wall()
         procs = [
             subprocess.Popen(
                 self._worker_argv(pid, port, restore),
@@ -763,7 +775,7 @@ class DistributedJobSupervisor:
         if self.selfheal is not None:
             # a probe fleet's health window starts at ITS spawn, not at
             # signal time (checkpoint+relaunch latency is not health)
-            self.selfheal.note_spawn(time.monotonic())
+            self.selfheal.note_spawn(self._clock())
         try:
             while True:
                 codes = [p.poll() for p in procs]
@@ -787,8 +799,25 @@ class DistributedJobSupervisor:
                         pending_target or self._read_signal() or self.nproc,
                         decision_level,
                     )
+                if self._heal_pending and self._beats_armed():
+                    # hb_dir is wiped at attempt start, so any beat file
+                    # proves THIS incarnation came up — that is the heal
+                    if any(
+                        os.path.exists(
+                            os.path.join(self.hb_dir, f"proc{i}.hb")
+                        )
+                        for i in range(self.nproc)
+                    ):
+                        from omldm_tpu.runtime.events import HEAL
+
+                        self._record(
+                            HEAL, "first_heartbeat",
+                            attempt=len(self.failures),
+                            processes=self.nproc,
+                        )
+                        self._heal_pending = False
                 if self.heartbeat_timeout_s > 0:
-                    now = time.time()
+                    now = self._wall()
                     stale = [
                         i
                         for i, rc in enumerate(codes)
@@ -809,7 +838,7 @@ class DistributedJobSupervisor:
                     # quietly for probeAfterMs gets signaled back toward
                     # the configured width (same RESCALE signal file +
                     # checkpoint/relaunch machinery as autoscale)
-                    mono = time.monotonic()
+                    mono = self._clock()
                     if self.selfheal.tick_healthy(mono):
                         self._log(
                             "probe healthy for "
@@ -855,7 +884,7 @@ class DistributedJobSupervisor:
                     signals = self.fleet_signals()
                     level = int(signals["level"]) if signals else -1
                     target = self.autoscale.decide(
-                        self.nproc, level, time.monotonic(),
+                        self.nproc, level, self._clock(),
                         signals=signals,
                     )
                     if target is not None and target != self.nproc:
@@ -924,7 +953,7 @@ class DistributedJobSupervisor:
             except OSError:
                 pass
         target = self.selfheal.note_failure(
-            exc.failed, exc.kinds, self.nproc, time.monotonic()
+            exc.failed, exc.kinds, self.nproc, self._clock()
         )
         for slot in exc.failed:
             self._record(
@@ -956,7 +985,7 @@ class DistributedJobSupervisor:
             to_procs=target,
             slots=list(exc.failed),
             kind=exc.kind(),
-            at=time.time(),
+            at=self._wall(),
         )
         self.degrades.append(record)
         self._log(
@@ -978,7 +1007,7 @@ class DistributedJobSupervisor:
         if self.autoscale is not None:
             # a degrade IS a rescale as far as autoscale pacing goes: give
             # the shrunken fleet the same cooldown before the next decision
-            self.autoscale.note_rescaled(time.monotonic())
+            self.autoscale.note_rescaled(self._clock())
         self._write_strike_file()
 
     def _apply_rescale(self, rescaled: "_FleetRescaled") -> None:
@@ -1002,7 +1031,7 @@ class DistributedJobSupervisor:
                 from_procs=self.nproc,
                 to_procs=rescaled.target,
                 level=rescaled.level,
-                at=time.time(),
+                at=self._wall(),
                 cause=cause,
             )
         )
@@ -1024,7 +1053,7 @@ class DistributedJobSupervisor:
         self.gather_incident("rescale")
         self.nproc = rescaled.target
         if self.autoscale is not None:
-            self.autoscale.note_rescaled(time.monotonic())
+            self.autoscale.note_rescaled(self._clock())
 
     def run(self) -> int:
         """Supervise to completion. Returns 0 on success; raises the last
@@ -1074,7 +1103,7 @@ class DistributedJobSupervisor:
                 attempt=next_attempt - 1,
                 cause=str(exc),
                 failed=getattr(exc, "failed", []),
-                at=time.time(),
+                at=self._wall(),
                 restored=self._checkpoint_exists(),
                 kind=(
                     exc.kind() if isinstance(exc, FleetFailure) else CRASH
@@ -1092,6 +1121,10 @@ class DistributedJobSupervisor:
                 failed=list(record.failed), attempt=record.attempt,
                 restored=record.restored, failure_kind=record.kind,
             )
+            # heal-after-fault: the next attempt's first heartbeat closes
+            # this restart's heal window (the load harness' SLO reads the
+            # restart->heal wall delta from the incident bundle)
+            self._heal_pending = True
             # bundle the dead fleet's rings BEFORE the relaunch
             # overwrites them — this is the supervised-worker-death
             # incident (no-op unarmed)
@@ -1122,7 +1155,7 @@ class DistributedJobSupervisor:
                     attempt=len(self.failures) + 1,
                     cause=exc.cause,
                     failed=exc.failed,
-                    at=time.time(),
+                    at=self._wall(),
                     restored=self._checkpoint_exists(),
                     kind=exc.kind(),
                 )

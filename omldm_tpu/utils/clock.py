@@ -1,0 +1,90 @@
+"""One home for the runtime's injectable clocks.
+
+Every wall-clock-coupled plane grew its own ``clock: Callable[[], float]``
+parameter — the AutoscalePolicy sustain windows, the collective
+HangWatchdog, the serving plane's maxDelayMs deadline, the flight
+recorder's silence poll, the self-heal probe windows, the restart
+backoff. Each one defaulted to a *different* stdlib clock (``monotonic``
+vs ``perf_counter`` vs ``time``) picked at its call site, and every test
+that wanted to fast-forward a wall-clock SLO re-invented a hand-rolled
+fake. This module is the single seam:
+
+- :data:`MONOTONIC`, :data:`WALL`, :data:`PERF` are the canonical system
+  clocks the runtime defaults to — sites say *which semantic* they need
+  instead of importing ``time`` themselves.
+- :class:`ManualClock` is the one deterministic test double: a callable
+  the planes accept anywhere a clock is injectable, with ``advance()`` /
+  ``set()`` for fast-forwarding wall-clock budgets (the load harness
+  drives heal-after-fault and serving-deadline SLOs through it without
+  sleeping).
+- :func:`resolve` normalizes an injected value (``None`` -> the named
+  default) so constructors stay one line.
+
+No reference counterpart: the reference's only clocks are Flink's
+internal timers (StatisticsOperator.scala:91,135-142).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+Clock = Callable[[], float]
+
+# the three clock semantics the runtime uses; sites reference these
+# instead of binding time.* at import time so a test that monkeypatches
+# the module-level names fast-forwards EVERY default-clocked object
+MONOTONIC: Clock = time.monotonic   # durations that must survive NTP steps
+WALL: Clock = time.time             # timestamps that cross processes
+PERF: Clock = time.perf_counter     # sub-ms latency measurement
+
+
+def resolve(clock: Optional[Clock], default: Clock = MONOTONIC) -> Clock:
+    """The injected clock, or the named system default when ``None``."""
+    return default if clock is None else clock
+
+
+class ManualClock:
+    """A deterministic, manually-advanced clock for tests and replay.
+
+    Callable (drop-in wherever a plane accepts ``clock=``), starts at
+    ``start`` and only moves when told to — so a test asserts a 30s
+    heal-after-fault budget breach by ``advance(31)`` instead of
+    sleeping, and two replays of the same advance script read identical
+    timestamps.
+    """
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by ``dt`` seconds (negative dt is refused —
+        none of the consumers tolerate a clock running backwards)."""
+        if dt < 0:
+            raise ValueError(f"cannot advance a clock backwards ({dt})")
+        self._now += float(dt)
+        return self._now
+
+    def set(self, t: float) -> float:
+        """Jump to absolute time ``t`` (must not move backwards)."""
+        if t < self._now:
+            raise ValueError(
+                f"cannot set clock backwards ({t} < {self._now})"
+            )
+        self._now = float(t)
+        return self._now
+
+    def sleep(self, dt: float) -> None:
+        """``time.sleep`` stand-in: advancing instead of blocking (for
+        sites that inject a sleep function alongside the clock, e.g.
+        ``kill_escalate``)."""
+        self.advance(dt)

@@ -1,0 +1,244 @@
+"""utils/clock.py — the one injectable-clock seam.
+
+Pins (a) ManualClock semantics (monotone, advance/set/sleep, refuses
+running backwards) and (b) that every wall-clock-coupled plane named by
+the unification actually accepts a ManualClock and reads time from it:
+SelfHealPolicy probe windows, HangWatchdog deadlines, the serving
+maxDelayMs deadline, the flight recorder's silence poll, and the
+supervisor's own clock pair. These are the seams the load harness
+fast-forwards to test wall-clock SLOs without sleeping."""
+
+import pytest
+
+from omldm_tpu.utils import clock as uclock
+from omldm_tpu.utils.clock import ManualClock
+
+
+# --- ManualClock semantics ----------------------------------------------
+
+
+def test_manual_clock_starts_at_start_and_is_callable():
+    mc = ManualClock(start=100.0)
+    assert mc() == 100.0
+    assert mc.now == 100.0
+
+
+def test_manual_clock_advance_returns_new_now():
+    mc = ManualClock()
+    assert mc.advance(2.5) == 2.5
+    assert mc() == 2.5
+    mc.advance(0.5)
+    assert mc() == 3.0
+
+
+def test_manual_clock_refuses_negative_advance():
+    mc = ManualClock(start=10.0)
+    with pytest.raises(ValueError):
+        mc.advance(-1.0)
+    assert mc() == 10.0
+
+
+def test_manual_clock_set_jumps_forward_only():
+    mc = ManualClock(start=5.0)
+    assert mc.set(9.0) == 9.0
+    with pytest.raises(ValueError):
+        mc.set(8.0)
+    assert mc() == 9.0
+
+
+def test_manual_clock_sleep_advances_instead_of_blocking():
+    mc = ManualClock()
+    mc.sleep(4.0)
+    assert mc() == 4.0
+
+
+def test_resolve_defaults_and_passthrough():
+    mc = ManualClock()
+    assert uclock.resolve(None) is uclock.MONOTONIC
+    assert uclock.resolve(None, uclock.WALL) is uclock.WALL
+    assert uclock.resolve(mc, uclock.WALL) is mc
+
+
+def test_named_clocks_tick():
+    # the canonical system clocks return floats and do not go backwards
+    for clk in (uclock.MONOTONIC, uclock.WALL, uclock.PERF):
+        a, b = clk(), clk()
+        assert isinstance(a, float)
+        assert b >= a
+
+
+# --- SelfHealPolicy probe windows ---------------------------------------
+
+
+def test_selfheal_probe_window_on_manual_clock():
+    from omldm_tpu.runtime.selfheal import SelfHealPolicy
+
+    mc = ManualClock()
+    pol = SelfHealPolicy(
+        strike_threshold=1,
+        configured=2,
+        min_processes=1,
+        probe_after_s=30.0,
+        probe_window_s=10.0,
+        clock=mc,
+    )
+    # one strike at threshold 1 degrades 2 -> 1
+    assert pol.note_failure([1], nproc=2) == 1
+    assert pol.degraded
+    # quiet period shorter than probe_after_s: hold
+    mc.advance(29.0)
+    assert pol.probe_target(1) is None
+    # past the window: probe back toward the configured width
+    mc.advance(2.0)
+    assert pol.probe_target(1) == 2
+
+
+def test_selfheal_probe_heals_after_window_on_manual_clock():
+    from omldm_tpu.runtime.selfheal import SelfHealPolicy
+
+    mc = ManualClock()
+    pol = SelfHealPolicy(
+        strike_threshold=1,
+        configured=2,
+        probe_after_s=5.0,
+        probe_window_s=10.0,
+        clock=mc,
+    )
+    pol.note_failure([0], nproc=2)
+    mc.advance(6.0)
+    assert pol.probe_target(1) == 2
+    pol.note_probe_signaled()
+    pol.note_spawn()  # probe fleet up; window clock starts here
+    mc.advance(9.0)
+    assert not pol.tick_healthy()  # still inside the probe window
+    mc.advance(2.0)
+    assert pol.tick_healthy()  # survived the window: healed
+    assert not pol.degraded
+
+
+# --- HangWatchdog deadlines ---------------------------------------------
+
+
+def test_hang_watchdog_deadline_on_manual_clock():
+    from omldm_tpu.runtime.selfheal import HangWatchdog
+
+    mc = ManualClock()
+    fired = []
+    wd = HangWatchdog(
+        timeout_s=10.0, on_expire=fired.append, clock=mc, thread=False
+    )
+    with wd.guard("allreduce"):
+        mc.advance(9.0)
+        assert not wd.check()
+        mc.advance(2.0)
+        assert wd.check()
+    assert fired == ["allreduce"]
+
+
+def test_hang_watchdog_disarmed_does_not_fire():
+    from omldm_tpu.runtime.selfheal import HangWatchdog
+
+    mc = ManualClock()
+    fired = []
+    wd = HangWatchdog(
+        timeout_s=1.0, on_expire=fired.append, clock=mc, thread=False
+    )
+    with wd.guard("step"):
+        pass  # exits before any advance
+    mc.advance(100.0)
+    assert not wd.check()
+    assert fired == []
+
+
+# --- serving maxDelayMs deadline ----------------------------------------
+
+
+class _StubQueueNet:
+    """Minimal net for ServingPlane unit tests (matches the unit-test
+    stub convention _limits() documents)."""
+
+    def __init__(self, net_id, serving_cfg):
+        from omldm_tpu.runtime.serving import ServeQueue
+
+        class _Req:
+            id = net_id
+
+        self.request = _Req()
+        self.serving = serving_cfg
+        self.serve_queue = ServeQueue()
+
+
+def test_serving_deadline_flush_on_manual_clock():
+    from omldm_tpu.api.data import DataInstance
+    from omldm_tpu.runtime.serving import ServingConfig, ServingPlane
+
+    mc = ManualClock()
+    out = []
+    plane = ServingPlane(emit_prediction=out.append, clock=mc)
+    net = _StubQueueNet(7, ServingConfig(max_batch=64, max_delay_ms=50.0))
+    inst = DataInstance(
+        id=1, numerical_features=[0.0], operation="forecasting"
+    )
+    plane.admit(net, inst, None)
+    assert net.serve_queue.t_oldest == 0.0  # stamped from the manual clock
+    # under the deadline: poll() leaves the queue pending
+    mc.advance(0.049)
+    plane.poll()
+    assert plane.queued() == 1
+
+
+# --- flight recorder silence poll ---------------------------------------
+
+
+def test_events_watchdog_silence_on_manual_clock():
+    from omldm_tpu.runtime.events import (
+        EventJournal,
+        EventsConfig,
+        Watchdog,
+    )
+
+    mc = ManualClock(start=1000.0)
+    alerts = []
+    cfg = EventsConfig(silence_ms=500.0)
+    wd = Watchdog(
+        cfg, EventJournal(cap=8, pid=0), on_alert=alerts.append, clock=mc
+    )
+    last_activity = mc()
+    mc.advance(0.4)
+    assert wd.poll_silence(last_activity) == []
+    mc.advance(0.2)  # 600ms of silence > 500ms budget
+    fired = wd.poll_silence(last_activity)
+    assert [f["cause"] for f in fired] == ["heartbeat_silence"]
+    assert alerts
+
+
+# --- supervisor clock pair ----------------------------------------------
+
+
+def test_supervisor_accepts_injected_clock_pair(tmp_path):
+    from omldm_tpu.runtime.supervisor import DistributedJobSupervisor
+
+    wall = ManualClock(start=5000.0)
+    mono = ManualClock(start=1.0)
+    sup = DistributedJobSupervisor(
+        worker_args=["--data", "x"],
+        num_processes=1,
+        run_dir=str(tmp_path),
+        clock=mono,
+        wall=wall,
+    )
+    # the blackbox floor is stamped from the injected wall clock
+    assert sup._blackbox_floor == 5000.0
+    assert sup._clock is mono and sup._wall is wall
+
+
+def test_supervisor_defaults_to_system_clocks(tmp_path):
+    from omldm_tpu.runtime.supervisor import DistributedJobSupervisor
+
+    sup = DistributedJobSupervisor(
+        worker_args=["--data", "x"],
+        num_processes=1,
+        run_dir=str(tmp_path),
+    )
+    assert sup._clock is uclock.MONOTONIC
+    assert sup._wall is uclock.WALL

@@ -1,0 +1,197 @@
+"""Load harness end-to-end (benchmarks/load_harness.py).
+
+Pins, per ISSUE 19:
+
+- the in-process leg: a composed storm (churn + diurnal + bursts +
+  addressed traffic) through the armed plane matrix passes every SLO
+  gate, and a replay of the same seed produces a byte-identical
+  deterministic report core;
+- the full-composition identity leg (satellite 3): every plane
+  configured-but-unarmed is bit-identical to the bare path at 256
+  tenants;
+- the supervised fleet leg: a seeded storm with two composed fault
+  classes (launch refusal + mid-stream crash) completes across
+  restarts with a passing SLO report — zero healthy-tenant loss,
+  exactly-once outputs, no stranded rows, bounded shed, heals observed
+  and within budget — and the count-clocked ``--requestSchedule``
+  churn survives checkpoint/restore.
+"""
+
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from benchmarks.load_harness import (
+    build_composed_storm,
+    default_storm_spec,
+    run_composition_identity,
+    run_inprocess_storm,
+    run_supervised_storm,
+)
+from omldm_tpu.runtime.loadgen import LoadStorm, StormSpec
+from omldm_tpu.runtime.slo import SLOBudgets
+
+
+def _small_storm(seed=11, **kw):
+    spec = default_storm_spec(
+        seed=seed, tenants=6, records=256, chunk_rows=32, **kw
+    )
+    return LoadStorm(spec)
+
+
+class TestInprocessLeg:
+    def test_composed_storm_passes_slo(self):
+        storm = _small_storm()
+        budgets = SLOBudgets(
+            allow_shed_tenants=storm.hot_tenant_ids(),
+            max_stranded_rows=0,
+        )
+        report, job = run_inprocess_storm(storm, budgets)
+        assert report.passed, [c.to_dict() for c in report.failing()]
+        # the scheduled churn actually ran: churned-in tenants produced
+        assert any(
+            p.mlp_id >= storm.spec.tenants for p in job.predictions
+        )
+
+    def test_replay_identical_core(self):
+        budgets = SLOBudgets(allow_shed_tenants=[], max_stranded_rows=0)
+        a, _ = run_inprocess_storm(_small_storm(), budgets)
+        b, _ = run_inprocess_storm(_small_storm(), budgets)
+        assert a.core_digest() == b.core_digest()
+        assert (
+            a.core_digest()
+            != run_inprocess_storm(_small_storm(seed=12), budgets)[
+                0
+            ].core_digest()
+        )
+
+
+class TestCompositionIdentity:
+    @pytest.mark.slow  # ~47s: two full 256-pipeline drives; the CI
+    # --slo-smoke gate runs this exact identity check as a hard failure
+    def test_unarmed_matrix_is_bit_identical_at_256_tenants(self):
+        # uniform broadcast traffic: no addressing, no bursts — the one
+        # regime where EVERY plane must be transparent (satellite 3)
+        storm = LoadStorm(StormSpec(
+            seed=5, tenants=256, records=128, chunk_rows=64,
+            n_features=4, forecast_ratio=0.4,
+        ))
+        bare, composed = run_composition_identity(storm)
+        assert bare == composed
+
+
+class TestFleetScaleControlPlane:
+    """A fleet-scale Create wave is far larger than one 64 KiB control
+    frame: the broadcast must stream it as continuation-flagged frames,
+    byte-identically and in order."""
+
+    def _job(self):
+        from omldm_tpu.config import JobConfig
+        from omldm_tpu.runtime.distributed_job import DistributedStreamJob
+
+        return DistributedStreamJob(
+            JobConfig(batch_size=8, test_set_size=8)
+        )
+
+    def test_frame_batches_pack_in_order_under_cap(self):
+        from omldm_tpu.runtime.distributed_job import CONTROL_CAP
+
+        storm = LoadStorm(StormSpec(
+            seed=1, tenants=2000, records=1, protocol="Synchronous",
+            training_extra={"syncEvery": 1},
+        ))
+        lines = storm.request_lines()
+        job = self._job()
+        batches = job._frame_batches(lines)
+        assert len(batches) > 1
+        assert [l for b in batches for l in b] == lines
+        cap = CONTROL_CAP - job._FRAME_HEADER
+        for b in batches:
+            assert len("\n".join(b).encode()) <= cap
+
+    def test_oversize_single_line_raises(self):
+        job = self._job()
+        with pytest.raises(ValueError):
+            job._frame_batches(["x" * (1 << 17)])
+
+    @pytest.mark.slow  # ~6s of 400 deploys; the frame-packing units
+    # above pin the protocol, --slo-smoke drives it at 10x this scale
+    def test_multi_frame_create_wave_deploys_every_tenant(self):
+        storm = LoadStorm(StormSpec(
+            seed=1, tenants=400, records=1, protocol="Synchronous",
+            training_extra={"syncEvery": 1},
+        ))
+        lines = storm.request_lines()
+        job = self._job()
+        assert len(job._frame_batches(lines)) > 1
+        job.sync_requests(lines)
+        assert sorted(job.pipelines) == list(range(400))
+
+
+class TestSupervisedLeg:
+    @pytest.mark.slow  # ~11s subprocess fleet; the CI --slo-smoke gate
+    # runs the same composed fault storm as a hard failure
+    def test_composed_fault_storm_passes_slo(self, tmp_path):
+        storm = build_composed_storm(
+            3, tenants=6, records=192, chunk_rows=32, processes=1,
+        )
+        assert {f.kind for f in storm.spec.faults} == {"launch", "crash"}
+        budgets = SLOBudgets(
+            heal_after_fault_s=120.0,
+            # launch refusal + the crash (which re-fires once per fresh
+            # incarnation until its record position is past the restore
+            # cursor) => at least two observed heals
+            expected_heals=2,
+            allow_shed_tenants=storm.hot_tenant_ids(),
+            max_stranded_rows=0,
+        )
+        report, merged, stderr = run_supervised_storm(
+            storm, str(tmp_path), budgets, processes=1,
+        )
+        assert report.passed, [c.to_dict() for c in report.failing()]
+        # restarts really happened (the faults fired)
+        assert merged is not None
+        heal = next(
+            c for c in report.checks if c.name == "heal_after_fault"
+        )
+        assert heal.detail["heals"] >= 2
+
+    @pytest.mark.slow
+    def test_supervised_replay_identical_core(self, tmp_path):
+        budgets = SLOBudgets(
+            heal_after_fault_s=120.0, expected_heals=2,
+            allow_shed_tenants=[0, 1], max_stranded_rows=0,
+        )
+        digests = []
+        for run in ("a", "b"):
+            storm = build_composed_storm(
+                3, tenants=6, records=192, chunk_rows=32, processes=1,
+            )
+            rep, _, _ = run_supervised_storm(
+                storm, str(tmp_path / run), budgets, processes=1,
+            )
+            assert rep.passed
+            digests.append(rep.core_digest())
+        assert digests[0] == digests[1]
+
+    @pytest.mark.slow
+    def test_two_process_storm_passes_slo(self, tmp_path):
+        storm = build_composed_storm(
+            9, tenants=6, records=192, chunk_rows=32, processes=2,
+        )
+        budgets = SLOBudgets(
+            heal_after_fault_s=120.0, expected_heals=2,
+            allow_shed_tenants=storm.hot_tenant_ids(),
+            max_stranded_rows=0,
+        )
+        report, merged, stderr = run_supervised_storm(
+            storm, str(tmp_path), budgets, processes=2,
+        )
+        assert report.passed, [c.to_dict() for c in report.failing()]

@@ -1,0 +1,329 @@
+"""Seeded storm generator (runtime/loadgen.py).
+
+Pins, per ISSUE 19:
+
+- same seed => same byte stream (fingerprint), different seed differs;
+- churn waves land on the chunk grid with Update/Delete-before-Create
+  ordering and non-colliding tenant ids;
+- the exact accounting (``expected_forecasts``) matches what a real
+  in-process run actually produces, fan-out and routed, with and
+  without Update-discard semantics;
+- fault specs render onto the existing injector flags verbatim;
+- fskafka preloading writes replayable topic logs the file-backed
+  consumer reads back byte-identically (offsets = line numbers).
+"""
+
+import json
+import os
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from omldm_tpu.runtime.loadgen import (
+    CREATE,
+    DELETE,
+    UPDATE,
+    ChurnEvent,
+    FaultSpec,
+    LoadStorm,
+    StormSpec,
+)
+
+DIM = 4
+
+
+def _spec(**kw):
+    base = dict(
+        seed=11, tenants=6, records=256, chunk_rows=32, n_features=DIM,
+        forecast_ratio=0.4, churn_waves=2, churn_tenants_per_wave=2,
+        churn_updates_per_wave=1,
+    )
+    base.update(kw)
+    return StormSpec(**base)
+
+
+# --- spec validation ---------------------------------------------------------
+
+
+class TestSpecValidation:
+    @pytest.mark.parametrize("bad", [
+        dict(tenants=0), dict(records=0), dict(chunk_rows=0),
+        dict(forecast_ratio=1.5), dict(forecast_ratio=-0.1),
+        dict(hot_tenants=99),
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            _spec(**bad)
+
+    def test_unknown_fault_kind_raises(self):
+        with pytest.raises(ValueError):
+            FaultSpec(kind="meteor")
+
+
+# --- determinism -------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_same_seed_same_bytes(self):
+        a, b = LoadStorm(_spec()), LoadStorm(_spec())
+        assert a.fingerprint() == b.fingerprint()
+        assert list(a.data_lines()) == list(b.data_lines())
+        assert a.request_lines() == b.request_lines()
+        assert a.schedule_lines() == b.schedule_lines()
+
+    def test_different_seed_differs(self):
+        assert (
+            LoadStorm(_spec()).fingerprint()
+            != LoadStorm(_spec(seed=12)).fingerprint()
+        )
+
+    def test_every_knob_reaches_the_stream(self):
+        base = LoadStorm(_spec()).fingerprint()
+        for kw in (
+            dict(records=224), dict(forecast_ratio=0.6),
+            dict(diurnal_amplitude=0.5, diurnal_period=64),
+            dict(hot_tenants=2, burst_every=64, burst_len=8),
+            dict(addressed_fraction=0.5), dict(churn_waves=3),
+        ):
+            assert LoadStorm(_spec(**kw)).fingerprint() != base, kw
+
+
+# --- churn schedule ----------------------------------------------------------
+
+
+class TestChurn:
+    def test_waves_are_chunk_aligned_and_ordered(self):
+        storm = LoadStorm(_spec())
+        s = storm.spec
+        assert storm.churn
+        for e in storm.churn:
+            assert e.at % s.chunk_rows == 0
+            assert 0 < e.at <= s.records
+
+    def test_churn_ids_never_collide_with_core(self):
+        storm = LoadStorm(_spec())
+        created = [e.tenant for e in storm.churn if e.action == CREATE]
+        assert min(created) >= storm.spec.tenants
+        assert len(created) == len(set(created))
+
+    def test_update_delete_target_previous_wave(self):
+        storm = LoadStorm(_spec(churn_waves=2, churn_tenants_per_wave=3,
+                                churn_updates_per_wave=1))
+        wave1 = [e for e in storm.churn if e.action == CREATE][:3]
+        managed = [e for e in storm.churn
+                   if e.action in (UPDATE, DELETE)]
+        assert {e.tenant for e in managed} == {e.tenant for e in wave1}
+        assert sum(e.action == UPDATE for e in managed) == 1
+        assert sum(e.action == DELETE for e in managed) == 2
+
+    def test_healthy_core_untouched(self):
+        storm = LoadStorm(_spec())
+        healthy = storm.healthy_tenants()
+        assert healthy == list(range(storm.spec.tenants))
+        churned = {e.tenant for e in storm.churn}
+        assert not churned & set(healthy)
+
+    def test_schedule_lines_sorted_and_parseable(self):
+        storm = LoadStorm(_spec())
+        ats = []
+        for line in storm.schedule_lines():
+            obj = json.loads(line)
+            ats.append(obj["atRecord"])
+            assert obj["request"]["request"] in (CREATE, UPDATE, DELETE)
+        assert ats == sorted(ats)
+
+
+# --- traffic shaping ---------------------------------------------------------
+
+
+class TestTraffic:
+    def test_bursts_address_hot_tenants_round_robin(self):
+        storm = LoadStorm(_spec(
+            churn_waves=0, churn_tenants_per_wave=0, hot_tenants=2,
+            burst_every=64, burst_len=8, addressed_fraction=0.0,
+        ))
+        lines = list(storm.data_lines())
+        for b in range(1, storm.spec.records // 64):
+            want = (b - 1) % 2
+            for i in range(b * 64, b * 64 + 8):
+                obj = json.loads(lines[i])
+                assert obj["metadata"]["tenant"] == want
+
+    def test_addressed_traffic_targets_alive_tenants_only(self):
+        storm = LoadStorm(_spec(addressed_fraction=0.6))
+        windows = storm.windows()
+        for i, line in enumerate(storm.data_lines()):
+            obj = json.loads(line)
+            t = (obj.get("metadata") or {}).get("tenant")
+            if t is None:
+                continue
+            assert any(a <= i < b for a, b, _ in windows[t]), (i, t)
+
+    def test_diurnal_curve_modulates_forecast_share(self):
+        storm = LoadStorm(_spec(
+            records=512, forecast_ratio=0.5, diurnal_amplitude=0.9,
+            diurnal_period=512, churn_waves=0, churn_tenants_per_wave=0,
+        ))
+        ops = [json.loads(l)["operation"] for l in storm.data_lines()]
+        peak = sum(op == "forecasting" for op in ops[:256])
+        trough = sum(op == "forecasting" for op in ops[256:])
+        assert peak > trough
+
+
+# --- exact accounting vs a real run -----------------------------------------
+
+
+def _drive(storm, **cfg_kw):
+    from omldm_tpu.config import JobConfig
+    from omldm_tpu.runtime.job import StreamJob
+
+    job = StreamJob(JobConfig(batch_size=16, test_set_size=16, **cfg_kw))
+    for line in storm.request_lines():
+        job.process_event("requests", line)
+    for stream, line in storm.events():
+        job.process_event(stream, line)
+    job.terminate()
+    counts = {}
+    for p in job.predictions:
+        counts[p.mlp_id] = counts.get(p.mlp_id, 0) + 1
+    return counts
+
+
+class TestExactAccounting:
+    def test_fanout_accounting_matches_real_run(self):
+        storm = LoadStorm(_spec(tenants=3, records=128))
+        counts = _drive(storm)
+        # in-process emits live: outputs of an Update-closed window survive
+        assert counts == storm.expected_forecasts(
+            routed=False, update_discards=False
+        )
+
+    def test_routed_accounting_matches_real_run(self):
+        storm = LoadStorm(_spec(
+            tenants=3, records=128, addressed_fraction=0.5,
+            hot_tenants=2, burst_every=32, burst_len=4,
+        ))
+        # overload armed => tenant-addressed records route to their
+        # addressee only; thresholds high enough that nothing sheds
+        counts = _drive(
+            storm, overload="window=64,share=64,hotHigh=1e8,hotCritical=1e9"
+        )
+        assert counts == storm.expected_forecasts(
+            routed=True, update_discards=False
+        )
+
+    def test_update_discard_accounting(self):
+        storm = LoadStorm(_spec())
+        keep = storm.expected_forecasts(update_discards=False)
+        drop = storm.expected_forecasts(update_discards=True)
+        updated = {e.tenant for e in storm.churn if e.action == UPDATE}
+        assert updated
+        for t in updated:
+            assert drop[t] < keep[t]
+        for t in storm.healthy_tenants():
+            assert drop[t] == keep[t]
+
+    def test_windows_partition_the_stream(self):
+        storm = LoadStorm(_spec())
+        for t, wins in storm.windows().items():
+            spans = sorted(wins)
+            for (a, b, _), (c, d, _) in zip(spans, spans[1:]):
+                assert b <= c
+            assert all(a < b or a == b for a, b, _ in spans)
+
+
+# --- fleet rendering ---------------------------------------------------------
+
+
+class TestFleetRendering:
+    def test_fault_flags_render_injector_argv(self, tmp_path):
+        storm = LoadStorm(_spec(faults=(
+            FaultSpec(kind="crash", process=1, at_records=64),
+            FaultSpec(kind="launch", process=0, count=2),
+            FaultSpec(kind="hang", process=2, at_chunks=3),
+            FaultSpec(kind="chaos", spec="seed=1,drop=0.1"),
+            FaultSpec(kind="sever", at_chunks=5),
+        )))
+        flags = storm.fault_flags(str(tmp_path / "faults"))
+        joined = " ".join(flags)
+        assert "--failProcess 1 --failAfterRecords 64" in joined
+        assert "--refuseLaunchProcess 0 --refuseLaunchCount 2" in joined
+        assert "--hangProcess 2 --hangAfterChunks 3" in joined
+        assert "--kafkaChaos seed=1,drop=0.1" in joined
+        assert "--severBrokerAfterChunks 5" in joined
+        assert "--faultStateDir" in joined
+
+    def test_no_faults_no_state_dir(self, tmp_path):
+        assert LoadStorm(_spec()).fault_flags(str(tmp_path)) == []
+
+    def test_write_files_and_worker_args(self, tmp_path):
+        storm = LoadStorm(_spec())
+        args = storm.worker_args(
+            str(tmp_path), checkpoint_every=2, extra=["--foo", "bar"],
+        )
+        joined = " ".join(args)
+        assert "--requestSchedule" in joined
+        assert "--checkpointEvery 2" in joined
+        assert joined.endswith("--foo bar")
+        paths = storm.write_files(str(tmp_path))
+        data = open(paths["data"]).read().splitlines()
+        assert data == list(storm.data_lines())
+        assert (
+            open(paths["schedule"]).read().splitlines()
+            == storm.schedule_lines()
+        )
+
+
+# --- fskafka preloading (satellite 1) ----------------------------------------
+
+
+class TestFskafkaPreload:
+    def test_preload_partitions_and_counts(self, tmp_path, monkeypatch):
+        from tests import fskafka
+
+        storm = LoadStorm(_spec())
+        d = str(tmp_path / "broker")
+        counts = storm.preload_fskafka(d, partitions=2)
+        n_fc = sum(1 for is_fc, _ in storm._records if is_fc)
+        assert counts["forecastingData"] == n_fc
+        assert counts["trainingData"] == storm.spec.records - n_fc
+        assert counts["requests"] == (
+            storm.spec.tenants + len(storm.churn)
+        )
+        # the file-backed consumer reads the identical byte stream back,
+        # offsets = line numbers
+        monkeypatch.setenv("FSKAFKA_DIR", d)
+        got = []
+        for part in (0, 1):
+            for topic in ("trainingData", "forecastingData"):
+                tp = fskafka.TopicPartition(topic, part)
+                log = fskafka._Log(topic, part)
+                if not os.path.exists(log.path):
+                    continue
+                end = fskafka.KafkaConsumer().end_offsets([tp])[tp]
+                lines = log.lines()
+                assert end == len(lines)
+                got.extend(l.decode() for l in lines)
+        assert sorted(got) == sorted(storm.data_lines())
+
+    def test_preload_truncates_previous_logs(self, tmp_path):
+        storm = LoadStorm(_spec())
+        d = str(tmp_path / "broker")
+        first = storm.preload_fskafka(d, partitions=1)
+        again = storm.preload_fskafka(d, partitions=1)
+        assert first == again
+        n = sum(
+            1 for _ in open(os.path.join(d, "trainingData--0.log"))
+        )
+        assert n == first["trainingData"]
+
+    def test_request_log_preserves_schedule_order(self, tmp_path):
+        storm = LoadStorm(_spec())
+        d = str(tmp_path / "broker")
+        storm.preload_fskafka(d)
+        lines = open(os.path.join(d, "requests--0.log")).read().splitlines()
+        want = storm.request_lines() + [
+            json.dumps(req) for _, req in storm.schedule_entries()
+        ]
+        assert lines == want

@@ -1,0 +1,277 @@
+"""SLO evaluator (runtime/slo.py).
+
+Pins, per ISSUE 19 satellite 4: every budget breach is individually
+triggerable and produces a reason-coded failing report —
+
+- ``HEALTHY_LOSS``     a healthy tenant under-produces;
+- ``DUPLICATE_OUTPUT`` over-production or a tenant that never existed;
+- ``STRANDED_ROWS``    rows left behind at terminate;
+- ``HEAL_TIMEOUT``     a slow heal, or fewer heals than scheduled;
+- ``SHED_SCOPE``       shed charged outside the allowed set;
+- ``P99_BUDGET``       serve p99 over budget (measured).
+
+Plus the report split: measured gates stay out of the deterministic
+core, the core digest is stable across evaluations, and the
+flight-recorder heal-time extraction (restart -> HEAL / first worker
+event, supersede rule) behaves.
+"""
+
+import pytest
+
+from omldm_tpu.runtime.slo import (
+    DUPLICATE_OUTPUT,
+    HEAL_TIMEOUT,
+    HEALTHY_LOSS,
+    P99_BUDGET,
+    SHED_SCOPE,
+    STRANDED_ROWS,
+    SLOBudgets,
+    count_prediction_lines,
+    evaluate,
+    heal_times_from_events,
+    p99_from_report,
+    shed_from_report,
+    stranded_from_report,
+)
+
+# a clean baseline every breach test perturbs ONE axis of
+EXPECTED = {0: 10, 1: 10, 2: 5}
+ACTUAL = {0: 10, 1: 10, 2: 5}
+HEALTHY = [0, 1]
+
+
+def _eval(**kw):
+    base = dict(
+        expected=dict(EXPECTED),
+        actual=dict(ACTUAL),
+        healthy=list(HEALTHY),
+        stranded_rows=0,
+        shed_by_tenant={},
+        fingerprint="f" * 64,
+        seed=7,
+    )
+    budgets = kw.pop("budgets", None) or SLOBudgets(
+        allow_shed_tenants=[2], max_stranded_rows=0,
+    )
+    base.update(kw)
+    return evaluate(budgets, **base)
+
+
+def _failing(report):
+    return {c.reason for c in report.failing()}
+
+
+class TestCleanRun:
+    def test_baseline_passes(self):
+        rep = _eval()
+        assert rep.passed
+        assert rep.failing() == []
+
+    def test_core_digest_stable_and_fingerprinted(self):
+        a, b = _eval(), _eval()
+        assert a.core_digest() == b.core_digest()
+        assert a.deterministic_core()["fingerprint"] == "f" * 64
+        assert _eval(fingerprint="0" * 64).core_digest() != a.core_digest()
+
+
+# --- each breach, individually (satellite 4) ---------------------------------
+
+
+class TestBreaches:
+    def test_healthy_loss(self):
+        rep = _eval(actual={0: 9, 1: 10, 2: 5})
+        assert not rep.passed
+        assert _failing(rep) == {HEALTHY_LOSS}
+        detail = rep.failing()[0].detail
+        assert detail["first"] == [
+            {"tenant": 0, "expected": 10, "actual": 9}
+        ]
+
+    def test_unhealthy_tenant_loss_is_not_a_breach(self):
+        # tenant 2 is churned (not in healthy): its under-production is
+        # the Update-discard semantics, not loss
+        assert _eval(actual={0: 10, 1: 10, 2: 3}).passed
+
+    def test_duplicate_output(self):
+        rep = _eval(actual={0: 10, 1: 11, 2: 5})
+        assert _failing(rep) == {DUPLICATE_OUTPUT}
+
+    def test_output_for_unknown_tenant_is_duplicate(self):
+        rep = _eval(actual={**ACTUAL, 99: 1})
+        assert _failing(rep) == {DUPLICATE_OUTPUT}
+        assert rep.failing()[0].detail["first"][0]["tenant"] == 99
+
+    def test_stranded_rows(self):
+        rep = _eval(stranded_rows=3)
+        assert _failing(rep) == {STRANDED_ROWS}
+        assert rep.failing()[0].detail == {"strandedRows": 3, "budget": 0}
+
+    def test_stranded_budget_allows_slack(self):
+        budgets = SLOBudgets(allow_shed_tenants=[2], max_stranded_rows=4)
+        assert _eval(budgets=budgets, stranded_rows=3).passed
+
+    def test_shed_scope(self):
+        rep = _eval(shed_by_tenant={0: 2})
+        assert _failing(rep) == {SHED_SCOPE}
+        assert rep.failing()[0].detail["first"] == [
+            {"tenant": 0, "shed": 2}
+        ]
+
+    def test_shed_inside_scope_passes(self):
+        assert _eval(shed_by_tenant={2: 100}).passed
+
+    def test_heal_timeout_slow_heal(self):
+        budgets = SLOBudgets(
+            heal_after_fault_s=1.0, expected_heals=1,
+            allow_shed_tenants=[2],
+        )
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 100.0},
+            {"pid": "sup", "kind": "heal", "wall": 105.0},
+        ]
+        rep = _eval(budgets=budgets, events=events)
+        assert _failing(rep) == {HEAL_TIMEOUT}
+        assert rep.failing()[0].detail["healSeconds"] == [5.0]
+
+    def test_heal_timeout_missing_heal(self):
+        # the fault storm scheduled 2 restarts; only 1 heal observed —
+        # a fault that never fired proves nothing, so this FAILS
+        budgets = SLOBudgets(
+            heal_after_fault_s=60.0, expected_heals=2,
+            allow_shed_tenants=[2],
+        )
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 100.0},
+            {"pid": "sup", "kind": "heal", "wall": 100.5},
+        ]
+        rep = _eval(budgets=budgets, events=events)
+        assert _failing(rep) == {HEAL_TIMEOUT}
+
+    def test_p99_budget(self):
+        budgets = SLOBudgets(serve_p99_ms=10.0, allow_shed_tenants=[2])
+        report = {"statistics": [
+            {"pipeline": 0, "serveLatencyP99Ms": 3.0},
+            {"pipeline": 1, "serveLatencyP99Ms": 25.0},
+        ]}
+        rep = _eval(budgets=budgets, report=report)
+        assert _failing(rep) == {P99_BUDGET}
+        assert rep.failing()[0].detail == {"p99Ms": 25.0, "budgetMs": 10.0}
+
+    def test_detail_caps_offender_list(self):
+        actual = {t: 0 for t in range(20)}
+        rep = _eval(
+            expected={t: 1 for t in range(20)}, actual=actual,
+            healthy=list(range(20)),
+        )
+        detail = rep.failing()[0].detail
+        assert detail["offenders"] == 20
+        assert len(detail["first"]) == 8
+
+
+# --- report split ------------------------------------------------------------
+
+
+class TestReportSplit:
+    def test_measured_gates_stay_out_of_the_core(self):
+        budgets = SLOBudgets(
+            serve_p99_ms=10.0, heal_after_fault_s=60.0, expected_heals=0,
+            allow_shed_tenants=[2],
+        )
+        rep = _eval(budgets=budgets, report={"statistics": []}, events=[])
+        core_names = {c["name"] for c in rep.deterministic_core()["checks"]}
+        measured = {c.name for c in rep.checks if c.measured}
+        assert measured == {"serve_p99", "heal_after_fault"}
+        assert not core_names & measured
+
+    def test_measured_breach_fails_overall_but_not_core(self):
+        budgets = SLOBudgets(
+            serve_p99_ms=1.0, allow_shed_tenants=[2],
+        )
+        slow = {"statistics": [{"pipeline": 0, "serveLatencyP99Ms": 50.0}]}
+        bad = _eval(budgets=budgets, report=slow)
+        ok = _eval(budgets=budgets, report={"statistics": [
+            {"pipeline": 0, "serveLatencyP99Ms": 0.5}]})
+        assert not bad.passed and ok.passed
+        assert bad.core_digest() == ok.core_digest()
+
+    def test_to_dict_shape(self):
+        d = _eval().to_dict()
+        assert d["passed"] is True
+        assert d["coreDigest"]
+        assert {c["name"] for c in d["deterministic"]["checks"]} == {
+            "healthy_forecast_loss", "exactly_once_outputs",
+            "stranded_rows", "shed_scope",
+        }
+
+
+# --- artifact extraction -----------------------------------------------------
+
+
+class TestExtraction:
+    def test_count_prediction_lines(self):
+        lines = [
+            '{"mlpId": 0, "value": 1.0}', "", '{"mlpId": 0, "value": 2.0}',
+            '{"mlpId": 3, "value": 0.5}',
+        ]
+        assert count_prediction_lines(lines) == {0: 2, 3: 1}
+
+    def test_p99_from_report_ignores_unmeasured(self):
+        assert p99_from_report({"statistics": [
+            {"pipeline": 0, "serveLatencyP99Ms": 0.0},
+            {"pipeline": 1},
+        ]}) is None
+        assert p99_from_report({"statistics": [
+            {"pipeline": 0, "serveLatencyP99Ms": 2.0},
+            {"pipeline": 1, "serveLatencyP99Ms": 7.0},
+        ]}) == 7.0
+
+    def test_shed_from_report(self):
+        assert shed_from_report({"statistics": [
+            {"pipeline": 0, "forecastsShed": 0},
+            {"pipeline": 1, "forecastsShed": 4},
+        ]}) == {1: 4}
+
+    def test_stranded_from_report(self):
+        assert stranded_from_report({}) is None
+        assert stranded_from_report(
+            {"terminateAccounting": {"backlogRows": 2}}
+        ) == 2
+        assert stranded_from_report({"terminateAccounting": {
+            "serving": 1, "paused": 2, "pressure_level": 9,
+        }}) == 3
+
+
+class TestHealTimes:
+    def test_restart_to_heal_event(self):
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 10.0},
+            {"pid": "sup", "kind": "heal", "wall": 11.5},
+            {"pid": "sup", "kind": "restart", "wall": 20.0},
+            {"pid": "sup", "kind": "heal", "wall": 20.25},
+        ]
+        assert heal_times_from_events(events) == [1.5, 0.25]
+
+    def test_worker_event_closes_the_window_too(self):
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 10.0},
+            {"pid": 0, "kind": "strike", "wall": 12.0},
+        ]
+        assert heal_times_from_events(events) == [2.0]
+
+    def test_later_restart_supersedes(self):
+        # the fleet never rose between the two restarts: the heal we
+        # time is decision -> the fleet that actually came up
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 10.0},
+            {"pid": "sup", "kind": "restart", "wall": 30.0},
+            {"pid": "sup", "kind": "heal", "wall": 31.0},
+        ]
+        assert heal_times_from_events(events) == [1.0]
+
+    def test_other_sup_events_do_not_close(self):
+        events = [
+            {"pid": "sup", "kind": "restart", "wall": 10.0},
+            {"pid": "sup", "kind": "rescale", "wall": 11.0},
+            {"pid": "sup", "kind": "heal", "wall": 12.0},
+        ]
+        assert heal_times_from_events(events) == [2.0]
